@@ -1,0 +1,22 @@
+"""Test config: force a hermetic 8-device virtual CPU mesh.
+
+Two things must happen before jax is first imported:
+
+* JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8 — the
+  real TPU here is a single chip; multi-chip sharding is validated on
+  virtual CPU devices.
+* remove the axon TPU-tunnel plugin (/root/.axon_site) from sys.path —
+  its registration eagerly dials the TPU pool even under
+  JAX_PLATFORMS=cpu, which hangs tests whenever the tunnel is busy.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p)

@@ -1,0 +1,289 @@
+"""Device matchers vs pure-Python oracle — randomized parity tests.
+
+These are the analog of the reference's TestRouteTable / rule-matching
+coverage in TestTcpLB (SURVEY.md §4): semantics are asserted against the
+oracle which replicates the Java scan loops line by line."""
+import random
+
+import numpy as np
+import pytest
+
+from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto, RouteRule, RouteTable
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.utils.ip import Network, parse_ip
+from vproxy_tpu.ops import tables
+from vproxy_tpu.ops.matchers import (cidr_first_match, hint_match, table_arrays)
+from vproxy_tpu.ops.bitmatch import unpack_bits
+
+rnd = random.Random(42)
+
+WORDS = ["a", "bb", "ccc", "x", "api", "web", "cdn", "img", "v2", "svc"]
+TLDS = ["com", "net", "io", "local"]
+
+
+def rand_domain():
+    n = rnd.randint(1, 3)
+    return ".".join(rnd.choice(WORDS) for _ in range(n)) + "." + rnd.choice(TLDS)
+
+
+def rand_uri():
+    n = rnd.randint(1, 4)
+    return "/" + "/".join(rnd.choice(WORDS) for _ in range(n))
+
+
+def rand_hint_rule():
+    host = None
+    uri = None
+    port = 0
+    while host is None and uri is None and port == 0:
+        if rnd.random() < 0.7:
+            host = "*" if rnd.random() < 0.1 else rand_domain()
+        if rnd.random() < 0.5:
+            uri = "*" if rnd.random() < 0.1 else rand_uri()
+        if rnd.random() < 0.3:
+            port = rnd.choice([80, 443, 8080])
+    return HintRule(host=host, port=port, uri=uri)
+
+
+def rand_hint():
+    host = rand_domain() if rnd.random() < 0.8 else None
+    # sometimes query an exact rule-ish domain with a sub-domain prefix
+    if host and rnd.random() < 0.5:
+        host = rnd.choice(WORDS) + "." + host
+    uri = rand_uri() if rnd.random() < 0.6 else None
+    port = rnd.choice([0, 80, 443, 8080])
+    return Hint(host=host, port=port, uri=uri)
+
+
+def test_hint_match_parity():
+    rules = [rand_hint_rule() for _ in range(200)]
+    hints = [rand_hint() for _ in range(500)]
+    # make sure plenty of exact hits exist
+    for i in range(0, 100, 3):
+        r = rules[i % len(rules)]
+        if r.host and r.host != "*":
+            hints[i] = Hint(host=r.host, port=r.port or 0, uri=r.uri)
+    t = table_arrays(tables.compile_hint_rules(rules))
+    q = tables.encode_hints(hints)
+    idx, level = hint_match(t, q["host"], q["has_host"],
+                            unpack_bits(q["uri"]), q["has_uri"], q["port"])
+    idx, level = np.asarray(idx), np.asarray(level)
+    for i, h in enumerate(hints):
+        want = oracle.search(rules, h)
+        assert idx[i] == want, (i, h, rules[idx[i]] if idx[i] >= 0 else None,
+                                rules[want] if want >= 0 else None)
+        if want >= 0:
+            assert level[i] == oracle.match_level(h, rules[want])
+
+
+def test_hint_scoring_cases():
+    rules = [
+        HintRule(host="example.com"),
+        HintRule(host="*"),
+        HintRule(host="a.example.com"),
+        HintRule(host="example.com", uri="/api"),
+        HintRule(uri="/api/v2"),
+        HintRule(uri="*"),
+        HintRule(host="example.com", port=443),
+    ]
+    cases = [
+        Hint.of_host("example.com"),              # exact -> 0
+        Hint.of_host("x.example.com"),            # suffix -> 0
+        Hint.of_host("a.example.com"),            # exact -> 2
+        Hint.of_host("other.org"),                # wildcard -> 1
+        Hint.of_host_uri("example.com", "/api"),  # host exact + uri -> 3
+        Hint.of_host_uri("example.com", "/api/v2"),  # 4 has longer uri but no host... 3 wins: 3<<10+5 vs 0+8
+        Hint.of_uri("/api/v2/things"),            # 4 (prefix len 7+1)
+        Hint.of_host_port("example.com", 443),    # exact + port: 0 and 6 tie at 3<<10 -> first wins (0)
+        Hint.of_host_port("example.com", 80),     # rule 6 port mismatch -> 0
+        Hint(host=None, uri=None, port=9999),     # no match against any? port-only query
+    ]
+    t = table_arrays(tables.compile_hint_rules(rules))
+    q = tables.encode_hints(cases)
+    idx, _ = hint_match(t, q["host"], q["has_host"], unpack_bits(q["uri"]),
+                        q["has_uri"], q["port"])
+    idx = np.asarray(idx)
+    for i, h in enumerate(cases):
+        assert idx[i] == oracle.search(rules, h), (i, h, idx[i])
+
+
+def rand_v4net():
+    ml = rnd.randint(0, 32)
+    ip = bytes(rnd.randint(0, 255) for _ in range(4))
+    return normalize_net(ip, ml)
+
+
+def normalize_net(ip: bytes, masklen: int) -> Network:
+    from vproxy_tpu.utils.ip import mask_bytes
+    mb = mask_bytes(masklen) if masklen > 0 else (b"\x00" * (4 if len(ip) == 4 else 4))
+    if masklen == 0:
+        mb = b"\x00" * 4
+    out = bytearray(len(ip))
+    for i in range(len(ip)):
+        out[i] = ip[i] & (mb[i] if i < len(mb) else 0)
+    return Network(bytes(out), mb)
+
+
+def rand_v6net():
+    ml = rnd.randint(0, 128)
+    style = rnd.random()
+    if style < 0.3:
+        ip = b"\x00" * 12 + bytes(rnd.randint(0, 255) for _ in range(4))
+    elif style < 0.5:
+        ip = b"\x00" * 10 + b"\xff\xff" + bytes(rnd.randint(0, 255) for _ in range(4))
+    else:
+        ip = bytes(rnd.randint(0, 255) for _ in range(16))
+    return normalize_net(ip, ml)
+
+
+def rand_addr():
+    if rnd.random() < 0.5:
+        return bytes(rnd.randint(0, 255) for _ in range(4))
+    style = rnd.random()
+    if style < 0.3:
+        return b"\x00" * 12 + bytes(rnd.randint(0, 255) for _ in range(4))
+    if style < 0.5:
+        return b"\x00" * 10 + b"\xff\xff" + bytes(rnd.randint(0, 255) for _ in range(4))
+    return bytes(rnd.randint(0, 255) for _ in range(16))
+
+
+def test_cidr_route_parity():
+    nets = []
+    seen = set()
+    while len(nets) < 150:
+        n = rand_v4net() if rnd.random() < 0.5 else rand_v6net()
+        if (n.ip, n.mask) in seen:
+            continue
+        seen.add((n.ip, n.mask))
+        nets.append(n)
+    addrs = [rand_addr() for _ in range(400)]
+    # seed addresses inside networks so matches happen
+    for i in range(0, 200, 2):
+        net = nets[i % len(nets)]
+        addrs[i] = net.ip if len(net.ip) in (4, 16) else addrs[i]
+
+    t = table_arrays(tables.compile_cidr_rules(nets))
+    a16, fam = tables.encode_ips(addrs)
+    got = np.asarray(cidr_first_match(t, a16, fam))
+    for i, a in enumerate(addrs):
+        want = -1
+        for j, net in enumerate(nets):
+            if net.contains_ip(a):
+                want = j
+                break
+        assert got[i] == want, (i, a.hex(), got[i], want)
+
+
+def test_route_table_insert_order():
+    rt = RouteTable()
+    rt.add(RouteRule("default", Network.parse("192.168.0.0/16"), to_vni=1))
+    rt.add(RouteRule("narrow", Network.parse("192.168.1.0/24"), to_vni=2))
+    rt.add(RouteRule("narrower", Network.parse("192.168.1.128/25"), to_vni=3))
+    rt.add(RouteRule("other", Network.parse("10.0.0.0/8"), to_vni=4))
+    assert rt.lookup(parse_ip("192.168.1.200")).alias == "narrower"
+    assert rt.lookup(parse_ip("192.168.1.5")).alias == "narrow"
+    assert rt.lookup(parse_ip("192.168.2.1")).alias == "default"
+    assert rt.lookup(parse_ip("10.1.2.3")).alias == "other"
+    assert rt.lookup(parse_ip("8.8.8.8")) is None
+    # device table built in list order must agree
+    t = table_arrays(tables.compile_route_table(rt.rules_v4))
+    a16, fam = tables.encode_ips([parse_ip(x) for x in
+                                  ["192.168.1.200", "192.168.1.5", "192.168.2.1",
+                                   "10.1.2.3", "8.8.8.8"]])
+    got = np.asarray(cidr_first_match(t, a16, fam))
+    aliases = [rt.rules_v4[i].alias if i >= 0 else None for i in got]
+    assert aliases == ["narrower", "narrow", "default", "other", None]
+
+
+def test_acl_parity():
+    rules = []
+    for i in range(60):
+        net = rand_v4net() if rnd.random() < 0.6 else rand_v6net()
+        lo = rnd.randint(0, 65000)
+        hi = rnd.randint(lo, 65535)
+        rules.append(AclRule(f"r{i}", net, rnd.choice([Proto.TCP, Proto.UDP]),
+                             lo, hi, rnd.random() < 0.5))
+    addrs = [rand_addr() for _ in range(300)]
+    ports = [rnd.randint(0, 65535) for _ in range(300)]
+    for proto in (Proto.TCP, Proto.UDP):
+        sub = [r for r in rules if r.protocol == proto]
+        t = table_arrays(tables.compile_acl(rules, proto))
+        a16, fam = tables.encode_ips(addrs)
+        idx = np.asarray(cidr_first_match(t, a16, fam, np.array(ports, np.int32)))
+        for i in range(len(addrs)):
+            want = oracle.acl_first_match(rules, proto, addrs[i], ports[i])
+            assert idx[i] == want, (proto, i, idx[i], want)
+            got_allow = bool(t["allow"][idx[i]]) if idx[i] >= 0 else True  # default
+            want_allow = oracle.acl_allow(rules, True, proto, addrs[i], ports[i])
+            if sub:
+                assert got_allow == want_allow
+
+
+def test_mask_match_mixed_families():
+    # IPv4-mapped & compatible v6 addresses against v4 rules and vice versa
+    n4 = Network.parse("127.0.0.0/8")
+    assert n4.contains_ip(parse_ip("127.6.6.6"))
+    assert n4.contains_ip(parse_ip("::7f00:1"))
+    assert n4.contains_ip(parse_ip("::ffff:127.0.0.1"))
+    assert not n4.contains_ip(parse_ip("1::7f00:1"))
+    n6 = Network.parse("::ffff:7f00:0/112")
+    assert n6.contains_ip(parse_ip("127.0.0.1"))
+    n6b = Network.parse("fe80::/10")
+    assert not n6b.contains_ip(parse_ip("127.0.0.1"))
+    assert n6b.contains_ip(parse_ip("fe80::1"))
+    # v6 rule with mask <= 32 never matches v4 input
+    n6c = Network.parse("fe00::/8")
+    assert not n6c.contains_ip(parse_ip("254.0.0.1"))
+
+
+def test_overlong_host_query_no_false_exact():
+    # a query host longer than MAX_HOST must not exact-match any rule,
+    # but its (truncated-tail) suffix match against short rules still works
+    long_label = "a" * 80
+    rules = [HintRule(host="x" * tables.MAX_HOST),
+             HintRule(host="corp.example.com")]
+    t = table_arrays(tables.compile_hint_rules(rules))
+    q = tables.encode_hints([
+        Hint.of_host(long_label + ".corp.example.com"),
+        Hint.of_host("x" * tables.MAX_HOST),
+    ])
+    idx, level = hint_match(t, q["host"], q["has_host"], unpack_bits(q["uri"]),
+                            q["has_uri"], q["port"])
+    assert list(np.asarray(idx)) == [1, 0]
+    assert list(np.asarray(level)) == [2 << 10, 3 << 10]
+    # over-capacity RULES are rejected loudly
+    with pytest.raises(ValueError):
+        tables.compile_hint_rules([HintRule(host="y" * (tables.MAX_HOST + 1))])
+
+
+def test_format_host_www_and_port():
+    from vproxy_tpu.rules.ir import format_host
+    # no port: pass through unchanged (www kept, empty kept)
+    assert format_host("www.example.com") == "www.example.com"
+    assert format_host("") == ""
+    assert format_host("::1") == "::1"
+    # with port: strip port, then www., empty -> None
+    assert format_host("www.example.com:80") == "example.com"
+    assert format_host("example.com:443") == "example.com"
+    assert format_host("www.:80") is None
+    # of_host("www.x") suffix-matches rule "x" rather than exact-matching
+    rules = [HintRule(host="www.example.com"), HintRule(host="example.com")]
+    assert oracle.search(rules, Hint.of_host("www.example.com")) == 0
+    t = table_arrays(tables.compile_hint_rules(rules))
+    q = tables.encode_hints([Hint.of_host("www.example.com"),
+                             Hint.of_host("www.example.com:8080")])
+    idx, _ = hint_match(t, q["host"], q["has_host"], unpack_bits(q["uri"]),
+                        q["has_uri"], q["port"])
+    assert list(np.asarray(idx)) == [0, 1]
+
+
+def test_max_length_host_suffix_match():
+    h64 = ("a" * 62) + ".b"  # exactly 64 bytes
+    assert len(h64) == 64
+    rules = [HintRule(host=h64)]
+    t = table_arrays(tables.compile_hint_rules(rules))
+    q = tables.encode_hints([Hint.of_host("sub." + h64), Hint.of_host(h64)])
+    idx, level = hint_match(t, q["host"], q["has_host"], unpack_bits(q["uri"]),
+                            q["has_uri"], q["port"])
+    assert list(np.asarray(idx)) == [0, 0]
+    assert list(np.asarray(level)) == [2 << 10, 3 << 10]

@@ -1,0 +1,50 @@
+"""Masked-equality matching as matmul (the MXU trick).
+
+Every rule-match site in the framework reduces to: does a query byte
+string equal a rule byte string under a per-rule byte mask?  We encode
+bytes as bit-planes (values in {0,1}) and use
+
+    popcount((q XOR r) AND m) = sum_k q_k*m_k + r_k*m_k - 2*q_k*r_k*m_k
+                              = q . (m - 2*r*m) + sum(r*m)
+
+so a [B, K] x [K, N] matmul + bias gives the per-(query, rule) mismatch
+count; a pattern matches iff its count is zero.  With bf16 operands and
+f32 accumulation this is exact (operands are in {-1, 0, 1} / {0, 1} and
+sums stay far below 2^24), and it maps straight onto the TPU MXU instead
+of the reference's per-connection Java scan (Upstream.java:187,
+RouteTable.java:44, SecurityGroup.java:30).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def compile_patterns(values: np.ndarray, masks: np.ndarray):
+    """Compile pattern bytes into matmul weights.
+
+    values, masks: uint8 [N, L] (mask is 0x00/0xff per byte; partial-byte
+    masks from CIDR prefixes are also supported bit-wise).
+    Returns (W [L*8, N] float32, c [N] float32).
+    """
+    assert values.shape == masks.shape
+    n, l = values.shape
+    vb = np.unpackbits(values, axis=1).astype(np.float32)  # [N, L*8]
+    mb = np.unpackbits(masks, axis=1).astype(np.float32)
+    w = (mb - 2.0 * vb * mb).T  # [L*8, N]
+    c = (vb * mb).sum(axis=1)  # [N]
+    return np.ascontiguousarray(w), np.ascontiguousarray(c)
+
+
+def unpack_bits(q: jnp.ndarray) -> jnp.ndarray:
+    """uint8 [..., L] -> float [..., L*8] bit-planes (MSB first, matching
+    np.unpackbits)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (q[..., None] >> shifts) & 1  # [..., L, 8]
+    return bits.reshape(*q.shape[:-1], q.shape[-1] * 8).astype(jnp.float32)
+
+
+def mismatch_counts(q_bits: jnp.ndarray, w: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """[B, K] x [K, N] + [N] -> [B, N] mismatch counts (exact)."""
+    return jnp.dot(q_bits.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32) + c

@@ -1,0 +1,119 @@
+"""Batched JAX matchers over compiled tables.
+
+These are the device kernels behind classify(): jit once, then feed
+micro-batches. Selection semantics reproduce the reference exactly:
+
+* hint match: strictly-greater max level, earliest rule wins ties
+  (Upstream.searchForGroup, Upstream.java:187-198); level encoding is
+  (host_level << 10) + uri_level (Hint.java:92-160).
+* cidr first-match: smallest rule index among matching patterns
+  (RouteTable.lookup RouteTable.java:44; SecurityGroup.allow
+  SecurityGroup.java:38-43).
+
+All matchers return plain arrays so they compose under jit/pjit and can
+be sharded over a device mesh along the rule axis (see parallel/mesh.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .bitmatch import mismatch_counts, unpack_bits
+
+HOST_SHIFT = 10
+NO_MATCH = jnp.int32(-1)
+
+
+def hint_match(table: dict, q_host: jnp.ndarray, q_has_host: jnp.ndarray,
+               q_uri_bits: jnp.ndarray,
+               q_has_uri: jnp.ndarray, q_port: jnp.ndarray):
+    # NOTE: uri scoring only needs the RULE-side length (uri_score): an exact
+    # uri match scores len(hint.uri)+1 and a prefix match len(rule.uri)+1,
+    # which coincide whenever both fire (Hint.java:144-152).
+    """-> (best_idx [B] i32 (-1 none), best_level [B] i32).
+
+    q_host: [B, HOST_SLOT] uint8 (reversed bytes + length byte)
+    q_uri_bits: [B, MAX_URI*8] f32 bit-planes
+    """
+    cap = table["active"].shape[0]
+
+    hb = unpack_bits(q_host)  # [B, HOST_SLOT*8]
+    hmm = mismatch_counts(hb, table["host_w"], table["host_c"])  # [B, cap*2]
+    hmatch = (hmm == 0).reshape(-1, cap, 2) & table["host_valid"][None]  # [B,cap,2]
+    exact, suffix = hmatch[..., 0], hmatch[..., 1]
+    host_level = jnp.maximum(
+        jnp.maximum(exact * 3, suffix * 2),
+        table["host_wild"][None].astype(jnp.int32) * 1,
+    )
+    host_level = jnp.where(q_has_host[:, None], host_level, 0)
+
+    umm = mismatch_counts(q_uri_bits, table["uri_w"], table["uri_c"])  # [B, cap]
+    prefix = (umm == 0) & table["uri_valid"][None]
+    uri_level = jnp.maximum(
+        prefix * table["uri_score"][None],
+        table["uri_wild"][None].astype(jnp.int32) * 1,
+    )
+    uri_level = jnp.where(q_has_uri[:, None], uri_level, 0)
+
+    level = (host_level << HOST_SHIFT) + uri_level
+    port_ok = (q_port[:, None] == 0) | (table["port"][None] == 0) | (
+        q_port[:, None] == table["port"][None])
+    level = jnp.where(port_ok & table["active"][None], level, 0)
+
+    # strictly-greater max, earliest index wins ties
+    order = jnp.arange(cap, dtype=jnp.int32)
+    key = level * cap + (cap - 1 - order)[None]
+    idx = jnp.argmax(key, axis=1).astype(jnp.int32)
+    best_level = jnp.take_along_axis(level, idx[:, None], axis=1)[:, 0]
+    return jnp.where(best_level > 0, idx, NO_MATCH), best_level
+
+
+def cidr_first_match(table: dict, q_addr: jnp.ndarray, q_family: jnp.ndarray,
+                     q_port: jnp.ndarray | None = None):
+    """-> first-matching rule index [B] i32, or -1.
+
+    q_addr: [B, 16] uint8 canonical; q_family: [B] i32 (0=v4, 1=v6).
+    q_port: [B] i32 for ACL tables (port-range gate), None for routes.
+    """
+    cap3 = table["valid"].shape[0]
+    cap = cap3 // 3
+    ab = unpack_bits(q_addr)  # [B, 128]
+    mm = mismatch_counts(ab, table["w"], table["c"])  # [B, cap*3]
+    match = (mm == 0) & table["valid"][None] & (
+        q_family[:, None] == table["family"][None])
+    rule_idx = (jnp.arange(cap3, dtype=jnp.int32) // 3)[None]  # pattern -> rule
+    if q_port is not None:
+        port_ok = (table["min_port"][None, rule_idx[0]] <= q_port[:, None]) & (
+            q_port[:, None] <= table["max_port"][None, rule_idx[0]])
+        match = match & port_ok
+    masked = jnp.where(match, rule_idx, jnp.int32(cap))
+    first = jnp.min(masked, axis=1).astype(jnp.int32)
+    return jnp.where(first < cap, first, NO_MATCH)
+
+
+@partial(jax.jit, static_argnames=())
+def classify_all(hint_table: dict, route_table: dict, acl_table: dict,
+                 hint_q: dict, route_q: dict, acl_q: dict):
+    """The fused flagship step: one dispatch classifies a micro-batch of
+    LB hints + DNS qnames (hint_q), route lookups and ACL checks."""
+    h_idx, h_level = hint_match(
+        hint_table, hint_q["host"], hint_q["has_host"],
+        unpack_bits(hint_q["uri"]), hint_q["has_uri"], hint_q["port"])
+    r_idx = cidr_first_match(route_table, route_q["addr"], route_q["family"])
+    a_idx = cidr_first_match(acl_table, acl_q["addr"], acl_q["family"],
+                             acl_q["port"])
+    a_allow = jnp.where(
+        a_idx >= 0, acl_table["allow"][jnp.maximum(a_idx, 0)], False)
+    return h_idx, h_level, r_idx, a_idx, a_allow
+
+
+def table_arrays(t) -> dict:
+    """HintTable/CidrTable dataclass -> dict of arrays (jit-friendly pytree)."""
+    import numpy as np
+    out = {}
+    for k, v in vars(t).items():
+        if isinstance(v, np.ndarray):
+            out[k] = v
+    return out
