@@ -1,0 +1,137 @@
+"""Benchmark: batched rule-classification throughput on one chip.
+
+North star (BASELINE.json): >=10M rule-matches/sec over a 100k-rule
+combined table (Host/SNI hints + DNS + LPM routes + ACL) at p99 classify
+latency < 50us. A "rule-match" is one query classified against a full
+table (the reference does this with a linear Java scan per connection:
+Upstream.java:187, RouteTable.java:44, SecurityGroup.java:30).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# honor the driver's environment; only force CPU if explicitly asked
+if "--cpu" in sys.argv:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+N_RULES = int(os.environ.get("BENCH_RULES", "100000"))
+N_ROUTE = int(os.environ.get("BENCH_ROUTES", "50000"))
+N_ACL = int(os.environ.get("BENCH_ACLS", "5000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+TARGET = 10_000_000.0  # rule-matches/sec north star
+
+
+def build():
+    from vproxy_tpu.ops import tables as T
+    from vproxy_tpu.ops.matchers import table_arrays
+    from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto
+    from vproxy_tpu.utils.ip import Network, mask_bytes
+
+    rnd = np.random.RandomState(11)
+
+    def dom(i):
+        return f"svc{i}.ns{i % 997}.apps.example.com"
+
+    hint_rules = []
+    for i in range(N_RULES):
+        r = i % 20
+        if r < 12:
+            hint_rules.append(HintRule(host=dom(i)))
+        elif r < 16:
+            hint_rules.append(HintRule(host=dom(i), uri=f"/api/v{i % 17}"))
+        elif r < 18:
+            hint_rules.append(HintRule(host=dom(i), port=443))
+        else:
+            hint_rules.append(HintRule(host=f"w{i}.example.com", uri="*"))
+
+    def v4net(i, ml):
+        ip = np.array([10 + (i % 13), (i >> 8) & 0xFF, i & 0xFF,
+                       (i * 37) & 0xFF], np.uint8)
+        m = np.frombuffer(mask_bytes(ml), np.uint8)
+        return Network(bytes(ip & m), bytes(m))
+
+    routes = [v4net(i, 8 + (i % 17)) for i in range(N_ROUTE)]
+    acls = [AclRule(f"r{i}", v4net(i * 3, 8 + (i % 25)), Proto.TCP,
+                    (i * 7) % 60000, (i * 7) % 60000 + 1000, i % 2 == 0)
+            for i in range(N_ACL)]
+
+    t0 = time.time()
+    ht = table_arrays(T.compile_hint_rules(hint_rules))
+    rt = table_arrays(T.compile_cidr_rules(routes))
+    at = table_arrays(T.compile_acl(acls, Proto.TCP))
+    compile_s = time.time() - t0
+
+    hints = []
+    for i in range(BATCH):
+        j = int(rnd.randint(0, N_RULES))
+        if i % 3 == 0:
+            hints.append(Hint.of_host(dom(j)))
+        elif i % 3 == 1:
+            hints.append(Hint.of_host_uri("x." + dom(j), f"/api/v{j % 17}/u"))
+        else:
+            hints.append(Hint.of_host_port(dom(j), 443))
+    hq = T.encode_hints(hints)
+    addrs = [bytes([10 + (int(x) % 13)] + list(np.random.bytes(3)))
+             for x in rnd.randint(0, 13, BATCH)]
+    a16, fam = T.encode_ips(addrs)
+    ports = rnd.randint(1, 65535, size=BATCH).astype(np.int32)
+    return ht, rt, at, hq, (a16, fam), ports, compile_s
+
+
+def main():
+    import jax
+    from vproxy_tpu.ops.bitmatch import unpack_bits
+    from vproxy_tpu.ops.matchers import cidr_match_jit, hint_match_jit
+    from vproxy_tpu.rules.engine import _to_device
+
+    ht, rt, at, hq, (a16, fam), ports, compile_s = build()
+    ht, rt, at = _to_device(ht), _to_device(rt), _to_device(at)
+    uri_bits = np.asarray(unpack_bits(hq["uri"]))
+
+    def step():
+        hi, _ = hint_match_jit(ht, hq["host"], hq["has_host"], uri_bits,
+                               hq["has_uri"], hq["port"])
+        ri = cidr_match_jit(rt, a16, fam, None)
+        ai = cidr_match_jit(at, a16, fam, ports)
+        return hi, ri, ai
+
+    # warmup / compile
+    t0 = time.time()
+    out = step()
+    [o.block_until_ready() for o in out]
+    warm_s = time.time() - t0
+
+    iters = int(os.environ.get("BENCH_ITERS", "30"))
+    lat = []
+    t0 = time.time()
+    for _ in range(iters):
+        t1 = time.time()
+        out = step()
+        [o.block_until_ready() for o in out]
+        lat.append(time.time() - t1)
+    total = time.time() - t0
+
+    # 3 classification queries per batch element (hint + route + acl)
+    matches = 3 * BATCH * iters
+    rate = matches / total
+    p50 = float(np.percentile(lat, 50) * 1e6)
+    p99 = float(np.percentile(lat, 99) * 1e6)
+    sys.stderr.write(
+        f"# rules={N_RULES}+{N_ROUTE}+{N_ACL} batch={BATCH} iters={iters} "
+        f"compile={compile_s:.1f}s warmup={warm_s:.1f}s "
+        f"step p50={p50:.0f}us p99={p99:.0f}us platform={jax.devices()[0].platform}\n")
+    print(json.dumps({
+        "metric": "rule-matches/sec @100k rules (Host+DNS hints, LPM, ACL)",
+        "value": round(rate, 1),
+        "unit": "matches/s",
+        "vs_baseline": round(rate / TARGET, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Engine layer + mesh sharding + graft entries on the virtual CPU mesh."""
+import numpy as np
+
+from vproxy_tpu.rules.engine import CidrMatcher, HintMatcher
+from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.utils.ip import Network, parse_ip
+
+
+def test_hint_matcher_update_in_place():
+    m = HintMatcher([HintRule(host="a.com"), HintRule(host="b.com")])
+    assert m.match_one(Hint.of_host("a.com")) == 0
+    assert m.match_one(Hint.of_host("b.com")) == 1
+    # runtime rule mutation: same capacity, no retrace, new answers
+    m.set_rules([HintRule(host="b.com"), HintRule(host="c.com")])
+    assert m.match_one(Hint.of_host("b.com")) == 0
+    assert m.match_one(Hint.of_host("c.com")) == 1
+    assert m.match_one(Hint.of_host("a.com")) == -1
+    # capacity growth beyond the bucket
+    rules = [HintRule(host=f"h{i}.x.io") for i in range(400)]
+    m.set_rules(rules)
+    assert m.match_one(Hint.of_host("h399.x.io")) == 399
+    assert m.match_one(Hint.of_host("sub.h17.x.io")) == 17
+
+
+def test_hint_matcher_host_backend_parity():
+    rules = [HintRule(host="a.com"), HintRule(host="*"),
+             HintRule(host="a.com", uri="/x")]
+    hints = [Hint.of_host("a.com"), Hint.of_host_uri("b.a.com", "/x/y"),
+             Hint.of_host("z.org")]
+    jaxm = HintMatcher(rules, backend="jax")
+    hostm = HintMatcher(rules, backend="host")
+    assert list(jaxm.match(hints)) == list(hostm.match(hints)) == [
+        oracle.search(rules, h) for h in hints]
+
+
+def test_cidr_matcher_acl():
+    acl = [
+        AclRule("deny9100", Network.parse("0.0.0.0/0"), Proto.TCP, 9100, 9100, False),
+        AclRule("lan", Network.parse("192.168.0.0/16"), Proto.TCP, 1, 65535, True),
+    ]
+    m = CidrMatcher([r.network for r in acl], acl=acl)
+    assert m.match_one(parse_ip("192.168.3.3"), 9100) == 0
+    assert m.match_one(parse_ip("192.168.3.3"), 443) == 1
+    assert m.match_one(parse_ip("8.8.8.8"), 443) == -1
+
+
+def test_graft_entry_single():
+    import __graft_entry__ as g
+    import jax
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    h_idx = np.asarray(out[0])
+    assert h_idx.shape == (256,)
+    # spot-check one element against the oracle path
+    assert (h_idx >= -1).all()
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)

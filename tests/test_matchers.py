@@ -287,3 +287,42 @@ def test_max_length_host_suffix_match():
                             q["has_uri"], q["port"])
     assert list(np.asarray(idx)) == [0, 0]
     assert list(np.asarray(level)) == [2 << 10, 3 << 10]
+
+
+def test_chunked_matchers_parity_and_cross_chunk_ties():
+    from vproxy_tpu.ops.matchers import (hint_match_chunked,
+                                         cidr_first_match_chunked)
+    # 3-chunk table with a duplicate host in chunk 0 and chunk 2: the
+    # earliest rule index must win the tie across chunks
+    chunk = 256
+    rules = [HintRule(host=f"h{i}.io") for i in range(700)]
+    rules[5] = HintRule(host="dup.example.com")
+    rules[600] = HintRule(host="dup.example.com")
+    hints = [Hint.of_host("dup.example.com"), Hint.of_host("h650.io"),
+             Hint.of_host("sub.h3.io"), Hint.of_host("nope.org")]
+    t = table_arrays(tables.compile_hint_rules(rules, cap=768))
+    q = tables.encode_hints(hints)
+    ub = unpack_bits(q["uri"])
+    direct = hint_match(t, q["host"], q["has_host"], ub, q["has_uri"], q["port"])
+    chunked = hint_match_chunked(t, q["host"], q["has_host"], ub,
+                                 q["has_uri"], q["port"], chunk=chunk)
+    assert list(np.asarray(chunked[0])) == list(np.asarray(direct[0])) == [5, 650, 3, -1]
+    assert list(np.asarray(chunked[1])) == list(np.asarray(direct[1]))
+
+    nets = [normalize_net(bytes([10, i % 256, (i // 256) % 256, 0]), 24)
+            for i in range(700)]
+    nets[650] = normalize_net(bytes([10, 0, 0, 0]), 8)  # broad rule late
+    addrs = [parse_ip("10.0.0.1"), parse_ip("10.44.0.9"), parse_ip("9.9.9.9")]
+    t = table_arrays(tables.compile_cidr_rules(nets, cap=768))
+    a16, fam = tables.encode_ips(addrs)
+    d = np.asarray(cidr_first_match(t, a16, fam))
+    c = np.asarray(cidr_first_match_chunked(t, a16, fam, chunk=chunk))
+    want = []
+    for a in addrs:
+        w = -1
+        for j, n in enumerate(nets):
+            if n.contains_ip(a):
+                w = j
+                break
+        want.append(w)
+    assert list(d) == list(c) == want

@@ -34,7 +34,14 @@ URI_MAX_SCORE = 1023
 V4, V6 = 0, 1
 
 
+MATCH_CHUNK = 8192  # rules per scan step in the chunked matchers
+
+
 def _pad_cap(n: int, bucket: int = 256) -> int:
+    # big tables pad to a multiple of MATCH_CHUNK so the scanned matchers
+    # can slice even chunks
+    if n > MATCH_CHUNK:
+        bucket = MATCH_CHUNK
     return max(bucket, ((n + bucket - 1) // bucket) * bucket)
 
 

@@ -1,0 +1,72 @@
+"""Device-mesh sharding for the classify engine.
+
+The scaling story (SURVEY.md §5 "distributed communication backend"):
+rule tables live in HBM sharded over the mesh's "rules" axis (the
+tensor-parallel analog — each chip holds a slice of every table and the
+argmax/min reduction rides ICI collectives inserted by the SPMD
+partitioner), while query micro-batches shard over "batch" (the
+data-parallel analog — the per-core event-loop sharding of
+app/Application.java:90-105 maps to batch shards). A single chip
+overflows neither HBM nor step-rate for the reference's scale, so the
+mesh exists for headroom and for multi-host DCN deployments where the
+control plane replicates tables per host.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None, batch: int = 1) -> Mesh:
+    """Mesh with axes (batch, rules); rules gets the remaining devices."""
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    n = len(devs)
+    assert n % batch == 0, (n, batch)
+    return Mesh(np.array(devs).reshape(batch, n // batch), ("batch", "rules"))
+
+
+# PartitionSpecs per table key: 2-D matmul weights shard on their rule
+# column axis, 1-D metadata shards on axis 0.
+_HINT_SPECS = {
+    "host_w": P(None, "rules"), "host_c": P("rules"),
+    "host_valid": P("rules", None), "host_wild": P("rules"),
+    "uri_w": P(None, "rules"), "uri_c": P("rules"),
+    "uri_valid": P("rules"), "uri_wild": P("rules"),
+    "uri_score": P("rules"), "port": P("rules"), "active": P("rules"),
+}
+_CIDR_SPECS = {
+    "w": P(None, "rules"), "c": P("rules"), "family": P("rules"),
+    "valid": P("rules"), "min_port": P("rules"), "max_port": P("rules"),
+    "allow": P("rules"),
+}
+_HINT_Q_SPECS = {
+    "host": P("batch", None), "has_host": P("batch"), "uri": P("batch", None),
+    "has_uri": P("batch"), "port": P("batch"),
+}
+
+
+def shard_hint_table(table: dict, mesh: Mesh) -> dict:
+    return {k: jax.device_put(v, NamedSharding(mesh, _HINT_SPECS[k]))
+            for k, v in table.items()}
+
+
+def shard_cidr_table(table: dict, mesh: Mesh) -> dict:
+    return {k: jax.device_put(v, NamedSharding(mesh, _CIDR_SPECS[k]))
+            for k, v in table.items()}
+
+
+def shard_hint_queries(q: dict, mesh: Mesh) -> dict:
+    return {k: jax.device_put(v, NamedSharding(mesh, _HINT_Q_SPECS[k]))
+            for k, v in q.items()}
+
+
+def shard_addr_queries(addr: np.ndarray, fam: np.ndarray, mesh: Mesh,
+                       port: Optional[np.ndarray] = None):
+    a = jax.device_put(addr, NamedSharding(mesh, P("batch", None)))
+    f = jax.device_put(fam, NamedSharding(mesh, P("batch")))
+    if port is None:
+        return a, f, None
+    return a, f, jax.device_put(port, NamedSharding(mesh, P("batch")))

@@ -1,0 +1,139 @@
+"""ClassifyEngine — the runtime seam between resources and the matchers.
+
+This is the TPU analog of the reference's per-connection match loops:
+components (Upstream, SecurityGroup, switch Table, DNSServer) register
+their rules here; data-plane code calls the batched query API. Mirrors
+the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
+`backend="jax" | "host"`: the host backend is the pure-Python oracle
+(correctness fallback + latency floor for tiny tables), the jax backend
+uploads compiled tables to the device and dispatches micro-batches.
+
+Rule updates never retrace: tables are fixed-capacity (padded), and an
+update recompiles numpy arrays and re-uploads same-shape buffers (the
+double-buffer swap — README "Modifiable when running").  Capacity grows
+by bucket when exceeded, which recompiles the jitted matcher once for
+the new shape.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops import tables as T
+from ..ops.matchers import cidr_match_jit, hint_match_jit, table_arrays
+from ..ops.bitmatch import unpack_bits
+from . import oracle
+from .ir import AclRule, Hint, HintRule, Proto
+
+
+def default_backend() -> str:
+    return os.environ.get("VPROXY_TPU_MATCHER", "jax")
+
+
+def _to_device(arrs: dict) -> dict:
+    import jax
+    import jax.numpy as jnp
+    out = {}
+    for k, v in arrs.items():
+        if v.dtype == np.float32 and v.ndim == 2:  # matmul weights -> bf16
+            out[k] = jax.device_put(jnp.asarray(v, dtype=jnp.bfloat16))
+        else:
+            out[k] = jax.device_put(v)
+    return out
+
+
+class HintMatcher:
+    """Device-backed (or host-fallback) Upstream/DNS hint matcher."""
+
+    def __init__(self, rules: Sequence[HintRule] = (), backend: Optional[str] = None):
+        self.backend = backend or default_backend()
+        self._rules: list[HintRule] = list(rules)
+        self._dev: Optional[dict] = None
+        self._recompile()
+
+    @property
+    def rules(self) -> list[HintRule]:
+        return list(self._rules)
+
+    def set_rules(self, rules: Sequence[HintRule]) -> None:
+        self._rules = list(rules)
+        self._recompile()
+
+    def _recompile(self) -> None:
+        if self.backend != "jax":
+            return
+        cap = self._dev["active"].shape[0] if self._dev is not None else None
+        if cap is not None and len(self._rules) > cap:
+            cap = None  # outgrew capacity: let the compiler pick a new bucket
+        tab = T.compile_hint_rules(self._rules, cap=cap)
+        self._dev = _to_device(table_arrays(tab))
+
+    def match(self, hints: Sequence[Hint]) -> np.ndarray:
+        """-> int32 [B] matched rule index, -1 for none."""
+        if not self._rules or not hints:
+            return np.full(len(hints), -1, np.int32)
+        if self.backend == "host":
+            return np.array([oracle.search(self._rules, h) for h in hints],
+                            np.int32)
+        q = T.encode_hints(hints)
+        idx, _ = hint_match_jit(
+            self._dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
+            q["has_uri"], q["port"])
+        return np.asarray(idx)
+
+    def match_one(self, hint: Hint) -> int:
+        return int(self.match([hint])[0])
+
+
+class CidrMatcher:
+    """Device-backed ordered first-match CIDR matcher (routes / ACL)."""
+
+    def __init__(self, networks: Sequence = (), backend: Optional[str] = None,
+                 acl: Optional[Sequence[AclRule]] = None):
+        self.backend = backend or default_backend()
+        self._nets = list(networks)
+        self._acl = list(acl) if acl is not None else None
+        self._dev: Optional[dict] = None
+        self._recompile()
+
+    def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None) -> None:
+        self._nets = list(networks)
+        self._acl = list(acl) if acl is not None else None
+        self._recompile()
+
+    def _recompile(self) -> None:
+        if self.backend != "jax":
+            return
+        cap = self._dev["allow"].shape[0] if self._dev is not None else None
+        if cap is not None and len(self._nets) > cap:
+            cap = None
+        tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
+        self._dev = _to_device(table_arrays(tab))
+
+    def match(self, addrs: Sequence[bytes],
+              ports: Optional[Sequence[int]] = None) -> np.ndarray:
+        """-> int32 [B] first matching rule index (order = insert order), -1
+        for none."""
+        if not self._nets or not addrs:
+            return np.full(len(addrs), -1, np.int32)
+        if self.backend == "host":
+            out = np.full(len(addrs), -1, np.int32)
+            for i, a in enumerate(addrs):
+                for j, net in enumerate(self._nets):
+                    if net.contains_ip(a) and (
+                            ports is None or self._acl is None or
+                            (self._acl[j].min_port <= ports[i] <= self._acl[j].max_port)):
+                        out[i] = j
+                        break
+            return out
+        a16, fam = T.encode_ips(addrs)
+        # route tables (acl=None) have zeroed port-range columns: the port
+        # gate must be skipped entirely or every port>0 query misses
+        p = None if (ports is None or self._acl is None) else np.asarray(ports, np.int32)
+        idx = cidr_match_jit(self._dev, a16, fam, p)
+        return np.asarray(idx)
+
+    def match_one(self, addr: bytes, port: Optional[int] = None) -> int:
+        return int(self.match([addr], None if port is None else [port])[0])
