@@ -1,0 +1,274 @@
+"""End-to-end TcpLB on loopback — the reference TestTcpLB pattern: tiny
+id-servers as backends so balancing decisions are assertable."""
+import socket
+import threading
+import time
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.secgroup import SecurityGroup
+from vproxy_tpu.components.servergroup import HealthCheckConfig, ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.rules.ir import AclRule, HintRule, Proto
+from vproxy_tpu.utils.ip import Network
+
+
+class IdServer:
+    """Accepts; on HTTP request replies with its id; raw mode sends id then
+    echoes."""
+
+    def __init__(self, sid: str, http: bool = False):
+        self.sid = sid.encode()
+        self.http = http
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self.hits = 0
+        self.alive = True
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while self.alive:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            threading.Thread(target=self._conn, args=(c,), daemon=True).start()
+
+    def _conn(self, c):
+        try:
+            if self.http:
+                data = b""
+                while b"\r\n\r\n" not in data and b"\n\n" not in data:
+                    d = c.recv(65536)
+                    if not d:
+                        break
+                    data += d
+                body = self.sid
+                c.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: %d\r\n\r\n%s"
+                          % (len(body), body))
+                c.close()
+            else:
+                c.sendall(self.sid)
+                while True:
+                    d = c.recv(65536)
+                    if not d:
+                        break
+                    c.sendall(d)
+                c.close()
+        except OSError:
+            pass
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def stack():
+    elgs = []
+    lbs = []
+    servers = []
+    groups = []
+
+    def make(n_workers=1):
+        elg = EventLoopGroup("w", n_workers)
+        elgs.append(elg)
+        return elg
+
+    yield {"make_elg": make, "lbs": lbs, "servers": servers, "groups": groups}
+    for lb in lbs:
+        lb.stop()
+    for g in groups:
+        g.close()
+    for s in servers:
+        s.close()
+    for e in elgs:
+        e.close()
+
+
+def fast_hc():
+    return HealthCheckConfig(timeout_ms=500, period_ms=100, up=1, down=1)
+
+
+def wait_healthy(group, n, timeout=5.0):
+    t0 = time.time()
+    while sum(1 for s in group.servers if s.healthy) < n:
+        if time.time() - t0 > timeout:
+            raise TimeoutError(f"only {[s.healthy for s in group.servers]}")
+        time.sleep(0.02)
+
+
+def tcp_get_id(port):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    sid = c.recv(100)
+    c.close()
+    return sid.decode()
+
+
+def http_get_id(port, host, path="/"):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"GET %s HTTP/1.1\r\nhost: %s\r\n\r\n" % (path.encode(), host.encode()))
+    data = b""
+    while b"\r\n\r\n" not in data:
+        d = c.recv(65536)
+        if not d:
+            break
+        data += d
+    head, _, body = data.partition(b"\r\n\r\n")
+    # read remaining body
+    while True:
+        try:
+            d = c.recv(65536)
+        except socket.timeout:
+            break
+        if not d:
+            break
+        body += d
+    c.close()
+    return head.split(b"\r\n")[0].decode(), body.decode()
+
+
+def test_tcp_mode_wrr_distribution(stack):
+    elg = stack["make_elg"](1)
+    s1, s2 = IdServer("A"), IdServer("B")
+    stack["servers"] += [s1, s2]
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port, weight=2)
+    g.add("b", "127.0.0.1", s2.port, weight=1)
+    wait_healthy(g, 2)
+    ups = Upstream("u")
+    ups.add(g)
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp")
+    stack["lbs"].append(lb)
+    lb.start()
+    ids = [tcp_get_id(lb.bind_port) for _ in range(12)]
+    assert ids.count("A") == 8 and ids.count("B") == 4  # 2:1 WRR
+    assert lb.accepted == 12
+
+
+def test_http_mode_host_rule_routing(stack):
+    elg = stack["make_elg"](1)
+    sa, sb, sc = IdServer("GA", http=True), IdServer("GB", http=True), IdServer("GC", http=True)
+    stack["servers"] += [sa, sb, sc]
+    ga = ServerGroup("ga", elg, fast_hc())
+    gb = ServerGroup("gb", elg, fast_hc())
+    gc = ServerGroup("gc", elg, fast_hc())
+    stack["groups"] += [ga, gb, gc]
+    ga.add("a", "127.0.0.1", sa.port)
+    gb.add("b", "127.0.0.1", sb.port)
+    gc.add("c", "127.0.0.1", sc.port)
+    for g in (ga, gb, gc):
+        wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(ga, annotations=HintRule(host="a.example.com"))
+    ups.add(gb, annotations=HintRule(host="example.com", uri="/api"))
+    ups.add(gc)  # no annotations: only reachable via WRR fallback
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="http")
+    stack["lbs"].append(lb)
+    lb.start()
+
+    status, body = http_get_id(lb.bind_port, "a.example.com")
+    assert status.endswith("200 OK") and body == "GA"
+    status, body = http_get_id(lb.bind_port, "sub.a.example.com")  # suffix
+    assert body == "GA"
+    status, body = http_get_id(lb.bind_port, "example.com", "/api/users")
+    assert body == "GB"
+    # no rule matches -> WRR over all three groups still serves
+    status, body = http_get_id(lb.bind_port, "other.org", "/x")
+    assert body in ("GA", "GB", "GC")
+
+
+def test_acl_denies_connection(stack):
+    elg = stack["make_elg"](1)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup("g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g)
+    sec = SecurityGroup("deny-lo", default_allow=True)
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               security_group=sec)
+    stack["lbs"].append(lb)
+    lb.start()
+    sec.add_rule(AclRule("no-lo", Network.parse("127.0.0.0/8"), Proto.TCP,
+                         1, 65535, False))
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(2)
+    assert c.recv(100) == b""  # immediately closed by ACL
+    c.close()
+    # flip to allow: remove the deny rule
+    sec.remove_rule("no-lo")
+    assert tcp_get_id(lb.bind_port) == "A"
+
+
+def test_health_check_failover(stack):
+    elg = stack["make_elg"](1)
+    s1, s2 = IdServer("A"), IdServer("B")
+    stack["servers"] += [s1, s2]
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    g.add("b", "127.0.0.1", s2.port)
+    wait_healthy(g, 2)
+    ups = Upstream("u")
+    ups.add(g)
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp")
+    stack["lbs"].append(lb)
+    lb.start()
+    # kill B; after the down edge all traffic goes to A
+    s2.close()
+    t0 = time.time()
+    while any(s.name == "b" and s.healthy for s in g.servers):
+        if time.time() - t0 > 5:
+            raise TimeoutError("b never went down")
+        time.sleep(0.02)
+    ids = {tcp_get_id(lb.bind_port) for _ in range(6)}
+    assert ids == {"A"}
+
+
+def test_separate_acceptor_and_worker_groups(stack):
+    acceptor = stack["make_elg"](1)
+    worker = stack["make_elg"](2)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup("g", worker, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g)
+    lb = TcpLB("lb", acceptor, worker, "127.0.0.1", 0, ups, protocol="tcp")
+    stack["lbs"].append(lb)
+    lb.start()
+    assert [tcp_get_id(lb.bind_port) for _ in range(6)] == ["A"] * 6
+
+
+def test_bind_conflict_raises(stack):
+    elg = stack["make_elg"](1)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup("g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    ups = Upstream("u")
+    ups.add(g)
+    lb1 = TcpLB("lb1", elg, elg, "127.0.0.1", 0, ups)
+    stack["lbs"].append(lb1)
+    lb1.start()
+    lb2 = TcpLB("lb2", elg, elg, "127.0.0.1", lb1.bind_port, ups)
+    with pytest.raises(OSError):
+        lb2.start()

@@ -1,0 +1,49 @@
+"""EventLoopGroup — named set of worker loops with round-robin next().
+
+Analog of component/elgroup/EventLoopGroup.java (round-robin next()
+:188-207, attach/detach resource lifecycle). Worker topology follows
+app/Application.java:83-114: one control loop + N worker loops.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Optional
+
+from ..net.eventloop import SelectorEventLoop
+
+
+class EventLoopGroup:
+    def __init__(self, name: str, n_loops: int = 1):
+        self.name = name
+        self.loops: list[SelectorEventLoop] = []
+        self._rr = itertools.count()
+        self._closed = False
+        self._resources: list = []
+        for i in range(n_loops):
+            lp = SelectorEventLoop(f"{name}-{i}")
+            lp.loop_thread()
+            self.loops.append(lp)
+
+    def next(self) -> SelectorEventLoop:
+        if not self.loops:
+            raise RuntimeError(f"event loop group {self.name} is empty")
+        return self.loops[next(self._rr) % len(self.loops)]
+
+    def attach(self, resource) -> None:
+        self._resources.append(resource)
+
+    def detach(self, resource) -> None:
+        if resource in self._resources:
+            self._resources.remove(resource)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for r in list(self._resources):
+            closer = getattr(r, "on_group_close", None)
+            if closer:
+                closer()
+        for lp in self.loops:
+            lp.close()

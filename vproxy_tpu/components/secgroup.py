@@ -1,0 +1,89 @@
+"""SecurityGroup — L4 ACL on the classify engine.
+
+Reference: component/secure/SecurityGroup.java (per-protocol ordered
+first-match lists, default allow/deny) and SecurityGroupRule.java. The
+per-rule linear scan becomes a CidrMatcher table query.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ..rules.engine import CidrMatcher
+from ..rules.ir import AclRule, Proto
+from ..utils.ip import Network
+
+
+class SecurityGroup:
+    DEFAULT_NAME = "(allow-all)"
+
+    def __init__(self, alias: str, default_allow: bool = True,
+                 backend: Optional[str] = None):
+        self.alias = alias
+        self.default_allow = default_allow
+        self._rules: list[AclRule] = []
+        self._backend = backend
+        self._matchers: dict[Proto, CidrMatcher] = {}
+        self._subs: dict[Proto, list[AclRule]] = {}  # snapshot per recalc
+        self._lock = threading.Lock()
+
+    @classmethod
+    def allow_all(cls) -> "SecurityGroup":
+        return cls(cls.DEFAULT_NAME, True)
+
+    @property
+    def rules(self) -> list[AclRule]:
+        return list(self._rules)
+
+    def add_rule(self, rule: AclRule) -> None:
+        with self._lock:
+            if any(r.alias == rule.alias for r in self._rules):
+                raise ValueError(f"rule {rule.alias} already exists in {self.alias}")
+            for r in self._rules:
+                if (r.network == rule.network and r.protocol == rule.protocol
+                        and r.min_port == rule.min_port and r.max_port == rule.max_port):
+                    raise ValueError(f"equivalent rule {r.alias} already exists")
+            self._rules.append(rule)
+            self._recalc(rule.protocol)
+
+    def remove_rule(self, alias: str) -> None:
+        with self._lock:
+            for i, r in enumerate(self._rules):
+                if r.alias == alias:
+                    del self._rules[i]
+                    self._recalc(r.protocol)
+                    return
+        raise KeyError(alias)
+
+    def _recalc(self, proto: Proto) -> None:
+        sub = [r for r in self._rules if r.protocol == proto]
+        if not sub:
+            self._matchers.pop(proto, None)
+            self._subs.pop(proto, None)
+            return
+        m = self._matchers.get(proto)
+        if m is None:
+            m = CidrMatcher([r.network for r in sub], backend=self._backend,
+                            acl=sub)
+        else:
+            m.set_networks([r.network for r in sub], acl=sub)
+        # publish matcher + the exact rule list it was compiled from together
+        self._subs[proto] = sub
+        self._matchers[proto] = m
+
+    def allow(self, proto: Proto, addr: bytes, port: int) -> bool:
+        m = self._matchers.get(proto)
+        if m is None:
+            return self.default_allow
+        sub = self._subs[proto]
+        idx = m.match_one(addr, port)
+        return sub[idx].allow if idx >= 0 else self.default_allow
+
+    def allow_batch(self, proto: Proto, addrs: Sequence[bytes],
+                    ports: Sequence[int]) -> list[bool]:
+        m = self._matchers.get(proto)
+        if m is None:
+            return [self.default_allow] * len(addrs)
+        sub = self._subs[proto]
+        return [sub[i].allow if i >= 0 else self.default_allow
+                for i in m.match(addrs, ports)]

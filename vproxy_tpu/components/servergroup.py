@@ -1,0 +1,310 @@
+"""ServerGroup — weighted backends with health checks and 3 balancing
+methods.
+
+Semantics from the reference (svrgroup/ServerGroup.java): WRR with the
+subtract-sum max-index sequence (:692-741) and a random start offset
+(:721-737); WLC least-connection with the C(Sm)*W(Si) > C(Si)*W(Sm)
+integer comparison (:527-560); `source` sdbm hash of the client address
+with linear probe past unhealthy servers (:389-398, :479-490); v4/v6
+filtered variants of each (nextIPv4/nextIPv6); health checks with up/down
+edge thresholds (check/HealthCheckClient.java:100-137).
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..net import vtl
+from ..net.eventloop import SelectorEventLoop
+from ..rules.ir import HintRule
+from .elgroup import EventLoopGroup
+
+
+@dataclass
+class HealthCheckConfig:
+    timeout_ms: int = 2000
+    period_ms: int = 5000
+    up: int = 2
+    down: int = 3
+    protocol: str = "tcp"  # none | tcp | tcpDelay | http
+
+
+@dataclass
+class ServerHandle:
+    name: str
+    ip: str
+    port: int
+    weight: int
+    healthy: bool = False
+    conn_count: int = 0
+    bytes_in: int = 0
+    bytes_out: int = 0
+    logic_delete: bool = False
+    host_name: Optional[str] = None
+    _up_cnt: int = 0
+    _down_cnt: int = 0
+
+    @property
+    def is_v4(self) -> bool:
+        return ":" not in self.ip
+
+
+class _HealthChecker:
+    """Periodic nonblocking connect on the group's event loop; edge-triggered
+    up/down transitions after N consecutive successes/failures."""
+
+    def __init__(self, loop: SelectorEventLoop, group: "ServerGroup",
+                 svr: ServerHandle):
+        self.loop = loop
+        self.group = group
+        self.svr = svr
+        self.stopped = False
+        self._periodic = None
+        loop.run_on_loop(self._start)
+
+    def _start(self) -> None:
+        if self.stopped:
+            return
+        cfg = self.group.hc
+        if cfg.protocol == "none":
+            self._result(True)
+            self._periodic = self.loop.period(cfg.period_ms, lambda: self._result(True))
+            return
+        self._periodic = self.loop.period(cfg.period_ms, self._check_once)
+        self._check_once()
+
+    def _check_once(self) -> None:
+        if self.stopped:
+            return
+        cfg = self.group.hc
+        try:
+            fd = vtl.tcp_connect(self.svr.ip, self.svr.port)
+        except OSError:
+            self._result(False)
+            return
+        state = {"done": False}
+
+        def finish(ok: bool) -> None:
+            if state["done"]:
+                return
+            state["done"] = True
+            if self.loop.registered(fd):
+                self.loop.remove(fd)
+            vtl.close(fd)
+            self._result(ok)
+
+        def on_ev(_fd: int, ev: int) -> None:
+            finish(vtl.finish_connect(fd) == 0)
+
+        self.loop.add(fd, vtl.EV_WRITE, on_ev)
+        self.loop.delay(cfg.timeout_ms, lambda: finish(False))
+
+    def _result(self, ok: bool) -> None:
+        if self.stopped:
+            return
+        s = self.svr
+        cfg = self.group.hc
+        if ok:
+            s._up_cnt += 1
+            s._down_cnt = 0
+            if not s.healthy and s._up_cnt >= cfg.up:
+                s.healthy = True
+                self.group._notify(s, True)
+        else:
+            s._down_cnt += 1
+            s._up_cnt = 0
+            if s.healthy and s._down_cnt >= cfg.down:
+                s.healthy = False
+                self.group._notify(s, False)
+            elif not s.healthy and s._down_cnt == cfg.down:
+                self.group._notify(s, False)
+
+    def stop(self) -> None:
+        self.stopped = True
+        if self._periodic is not None:
+            self.loop.run_on_loop(self._periodic.cancel)
+
+
+class Connector:
+    """How to reach a chosen backend (SvrHandleConnector analog)."""
+
+    def __init__(self, svr: ServerHandle, group: "ServerGroup"):
+        self.svr = svr
+        self.group = group
+        self.ip = svr.ip
+        self.port = svr.port
+
+
+class ServerGroup:
+    METHODS = ("wrr", "wlc", "source")
+
+    def __init__(self, alias: str, elg: EventLoopGroup,
+                 hc: Optional[HealthCheckConfig] = None, method: str = "wrr",
+                 annotations: Optional[HintRule] = None):
+        if method not in self.METHODS:
+            raise ValueError(f"unsupported method {method}")
+        self.alias = alias
+        self.elg = elg
+        self.hc = hc or HealthCheckConfig()
+        self.method = method
+        self.annotations = annotations or HintRule()
+        self.servers: list[ServerHandle] = []
+        self._checkers: dict[str, _HealthChecker] = {}
+        self._listeners: list[Callable[[ServerHandle, bool], None]] = []
+        self._lock = threading.Lock()
+        self._wrr_seq: list[int] = []
+        self._wrr_servers: list[ServerHandle] = []
+        self._wrr_cursor = 0
+        self._wrr_cache: dict[str, tuple] = {}
+
+    # ------------------------------------------------------------- admin
+
+    def add(self, name: str, ip: str, port: int, weight: int = 10) -> ServerHandle:
+        with self._lock:
+            if any(s.name == name for s in self.servers):
+                raise ValueError(f"server {name} already exists in {self.alias}")
+            s = ServerHandle(name=name, ip=ip, port=port, weight=weight)
+            self.servers.append(s)
+            self._recalc()
+        self._checkers[name] = _HealthChecker(self.elg.next(), self, s)
+        return s
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            for i, s in enumerate(self.servers):
+                if s.name == name:
+                    del self.servers[i]
+                    self._recalc()
+                    break
+            else:
+                raise KeyError(name)
+        chk = self._checkers.pop(name, None)
+        if chk:
+            chk.stop()
+
+    def set_weight(self, name: str, weight: int) -> None:
+        with self._lock:
+            for s in self.servers:
+                if s.name == name:
+                    s.weight = weight
+                    self._recalc()
+                    return
+        raise KeyError(name)
+
+    def on_health_change(self, cb: Callable[[ServerHandle, bool], None]) -> None:
+        self._listeners.append(cb)
+
+    def _notify(self, svr: ServerHandle, up: bool) -> None:
+        for cb in self._listeners:
+            cb(svr, up)
+
+    def close(self) -> None:
+        for chk in self._checkers.values():
+            chk.stop()
+        self._checkers.clear()
+
+    # --------------------------------------------------------- balancing
+
+    def _recalc(self) -> None:
+        self._wrr_cache.clear()
+
+    @staticmethod
+    def _wrr_compute(servers: list[ServerHandle]) -> list[int]:
+        """The reference's subtract-sum sequence: repeatedly pick max-weight
+        index, subtract the total, re-add originals until all zero."""
+        if not servers:
+            return []
+        weights = [s.weight for s in servers]
+        original = list(weights)
+        total = sum(weights)
+        seq: list[int] = []
+        while True:
+            idx = max(range(len(weights)), key=lambda i: (weights[i], -i))
+            seq.append(idx)
+            weights[idx] -= total
+            if all(w == 0 for w in weights):
+                break
+            for i in range(len(weights)):
+                weights[i] += original[i]
+            total = sum(weights)
+        # random rotation so multiple identical instances don't sync
+        start = random.randrange(len(seq))
+        return seq[start:] + seq[:start]
+
+    def _subset(self, fam: Optional[str]) -> list[ServerHandle]:
+        out = [s for s in self.servers if s.weight > 0]
+        if fam == "v4":
+            out = [s for s in out if s.is_v4]
+        elif fam == "v6":
+            out = [s for s in out if not s.is_v4]
+        return out
+
+    def _wrr_state(self, fam: Optional[str]):
+        key = fam or "all"
+        st = self._wrr_cache.get(key)
+        if st is None:
+            servers = self._subset(fam)
+            st = {"servers": servers, "seq": self._wrr_compute(servers),
+                  "cursor": 0}
+            self._wrr_cache[key] = st
+        return st
+
+    def next(self, source_ip: Optional[bytes] = None,
+             fam: Optional[str] = None) -> Optional[Connector]:
+        if self.method == "wlc":
+            return self._wlc_next(fam)
+        if self.method == "source":
+            return self._source_next(source_ip or b"", fam)
+        return self._wrr_next(fam)
+
+    def _wrr_next(self, fam) -> Optional[Connector]:
+        with self._lock:
+            st = self._wrr_state(fam)
+            seq, servers = st["seq"], st["servers"]
+            for _ in range(len(seq) + 1):
+                if not seq:
+                    return None
+                idx = st["cursor"] % len(seq)
+                st["cursor"] = idx + 1
+                s = servers[seq[idx]]
+                if s.healthy:
+                    return Connector(s, self)
+            return None
+
+    def _wlc_next(self, fam) -> Optional[Connector]:
+        with self._lock:
+            servers = [s for s in self._subset(fam) if s.healthy]
+            if not servers:
+                return None
+            m = servers[0]
+            for s in servers[1:]:
+                if m.conn_count * s.weight > s.conn_count * m.weight:
+                    m = s
+            return Connector(m, self)
+
+    @staticmethod
+    def _sdbm(data: bytes) -> int:
+        h = 0
+        for b in data:
+            sb = b - 256 if b > 127 else b  # signed byte like Java
+            h = (sb + (h << 6) + (h << 16) - h) & 0xFFFFFFFF
+        if h & 0x80000000:
+            h = (~h + 1) & 0xFFFFFFFF  # abs in int32 space
+            if h & 0x80000000:  # Integer.MIN_VALUE edge
+                h = 0
+        return h
+
+    def _source_next(self, source_ip: bytes, fam) -> Optional[Connector]:
+        with self._lock:
+            servers = self._subset(fam)
+            if not servers:
+                return None
+            idx = self._sdbm(source_ip) % len(servers)
+            for _ in range(len(servers)):
+                s = servers[idx % len(servers)]
+                if s.healthy:
+                    return Connector(s, self)
+                idx += 1
+            return None

@@ -1,0 +1,197 @@
+"""TcpLB — the TCP/HTTP load balancer resource.
+
+Reference: component/app/TcpLB.java — per-acceptor-loop server socks
+(:201-250), per-connection classify = securityGroup.allow then
+backend.next(clientAddr, hint) (:166-180), worker round-robin (:182-199).
+
+TPU-first data path: accept and classification decisions run in Python
+(ACL + hint through the device matchers); once the backend connection is
+up and buffered head bytes are flushed, the session drops into the
+native splice pump (C++, net/native/vtl.cpp) and never touches the
+interpreter again. protocol="tcp" splices immediately; protocol="http"
+parses the first request head for a Host/URI hint (processors/http1.py)
+before splicing.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..net import vtl
+from ..net.connection import Connection, Handler, ServerSock
+from ..processors.http1 import HeadParser
+from ..rules.ir import Proto
+from ..utils.ip import parse_ip
+from .elgroup import EventLoopGroup
+from .secgroup import SecurityGroup
+from .servergroup import Connector
+from .upstream import Upstream
+
+
+class TcpLB:
+    def __init__(self, alias: str, acceptor: EventLoopGroup,
+                 worker: EventLoopGroup, bind_ip: str, bind_port: int,
+                 backend: Upstream, protocol: str = "tcp",
+                 security_group: Optional[SecurityGroup] = None,
+                 in_buffer_size: int = 65536, timeout_ms: int = 900_000):
+        if protocol not in ("tcp", "http"):
+            raise ValueError(f"unsupported protocol {protocol}")
+        self.alias = alias
+        self.acceptor = acceptor
+        self.worker = worker
+        self.bind_ip = bind_ip
+        self.bind_port = bind_port
+        self.backend = backend
+        self.protocol = protocol
+        self.security_group = security_group or SecurityGroup.allow_all()
+        self.in_buffer_size = in_buffer_size
+        self.timeout_ms = timeout_ms
+        self.server_socks: list[ServerSock] = []
+        self.started = False
+        # stats (cmd/ResourceType accepted-conn-count / bytes-in / bytes-out)
+        self.accepted = 0
+        self.active_sessions = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        if self.started:
+            return
+        self.started = True
+        done = []
+        errors = []
+        for lp in self.acceptor.loops:
+            def mk(lp=lp):
+                try:
+                    self.server_socks.append(ServerSock(
+                        lp, self.bind_ip, self.bind_port,
+                        lambda fd, ip, port, lp=lp: self._on_accept(lp, fd, ip, port),
+                        reuseport=len(self.acceptor.loops) > 1))
+                except OSError as e:
+                    errors.append(e)
+                finally:
+                    done.append(1)
+            lp.run_on_loop(mk)
+        import time
+        t0 = time.time()
+        while len(done) < len(self.acceptor.loops) and time.time() - t0 < 5:
+            time.sleep(0.002)
+        if errors or len(self.server_socks) < len(self.acceptor.loops):
+            self.stop()
+            self.started = False
+            raise OSError(
+                f"tcp-lb {self.alias}: bind failed on "
+                f"{self.bind_ip}:{self.bind_port}: {errors[:1] or 'timeout'}")
+        if self.bind_port == 0:
+            self.bind_port = self.server_socks[0].port
+
+    def stop(self) -> None:
+        if not self.started:
+            return
+        self.started = False
+        for ss in self.server_socks:
+            ss.loop.run_on_loop(ss.close)
+        self.server_socks = []
+
+    # --------------------------------------------------------- data plane
+
+    def _on_accept(self, loop, cfd: int, ip: str, port: int) -> None:
+        self.accepted += 1
+        # ACL gate (SecurityGroup.allow — TcpLB.java:168-171)
+        if not self.security_group.allow(Proto.TCP, parse_ip(ip), self.bind_port):
+            vtl.close(cfd)
+            return
+        if self.worker is not self.acceptor:
+            wl = self.worker.next()
+            wl.run_on_loop(lambda: self._serve(wl, cfd, ip, port))
+        else:
+            self._serve(loop, cfd, ip, port)
+
+    def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
+        if self.protocol == "tcp":
+            conn = self.backend.next(parse_ip(ip))
+            if conn is None:
+                vtl.close(cfd)
+                return
+            self._splice(loop, cfd, conn, b"")
+        else:
+            self._http_classify(loop, cfd, ip, port)
+
+    def _http_classify(self, loop, cfd: int, ip: str, port: int) -> None:
+        lb = self
+        parser = HeadParser()
+        front = Connection(loop, cfd, (ip, port))
+
+        class Front(Handler):
+            def on_data(self, conn: Connection, data: bytes) -> None:
+                parser.feed(data)
+                if parser.error:
+                    conn.close()
+                    return
+                if parser.done:
+                    conn.pause_reading()
+                    hint = parser.hint()
+                    back = lb.backend.next(parse_ip(ip), hint)
+                    if back is None:
+                        conn.write(b"HTTP/1.1 503 Service Unavailable\r\n"
+                                   b"content-length: 0\r\nconnection: close\r\n\r\n")
+                        loop.delay(50, conn.close)
+                        return
+                    buffered = bytes(parser.buf)
+                    ffd = conn.detach()
+                    lb._splice(loop, ffd, back, buffered)
+
+            def on_eof(self, conn: Connection) -> None:
+                conn.close()
+
+        front.set_handler(Front())
+
+    def _splice(self, loop, front_fd: int, target: Connector,
+                head: bytes) -> None:
+        lb = self
+        svr = target.svr
+        svr.conn_count += 1
+        self.active_sessions += 1
+        try:
+            back = Connection.connect(loop, target.ip, target.port)
+        except OSError:
+            svr.conn_count -= 1
+            self.active_sessions -= 1
+            vtl.close(front_fd)
+            return
+
+        class Back(Handler):
+            def on_connected(self, conn: Connection) -> None:
+                if head:
+                    conn.write(head)
+                if conn.out:
+                    # wait for drain before pump handover
+                    return
+                self._handover(conn)
+
+            def on_drained(self, conn: Connection) -> None:
+                self._handover(conn)
+
+            def _handover(self, conn: Connection) -> None:
+                if conn.detached or conn.closed:
+                    return
+                bfd = conn.detach()
+                vtl.set_nodelay(front_fd)
+                vtl.set_nodelay(bfd)
+                loop.pump(front_fd, bfd, lb.in_buffer_size, self._done)
+
+            def _done(self, a2b: int, b2a: int, err: int) -> None:
+                lb.bytes_in += a2b
+                lb.bytes_out += b2a
+                svr.bytes_in += a2b
+                svr.bytes_out += b2a
+                svr.conn_count -= 1
+                lb.active_sessions -= 1
+
+            def on_closed(self, conn: Connection, err: int) -> None:
+                svr.conn_count -= 1
+                lb.active_sessions -= 1
+                vtl.close(front_fd)
+
+        back.set_handler(Back())

@@ -1,0 +1,119 @@
+"""Upstream — groups-of-groups with hint-based selection on the classify
+engine.
+
+Reference: component/svrgroup/Upstream.java — weighted-RR across
+ServerGroups (seq :68-116), hint selection via searchForGroup (:187-198).
+THE difference: the linear annotation scan is replaced by the device
+HintMatcher (vproxy_tpu/rules/engine.py) — the rule table lives in HBM
+and single queries or micro-batches go through the same compiled kernel.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+from ..rules.engine import HintMatcher
+from ..rules.ir import Hint, HintRule
+from .servergroup import Connector, ServerGroup
+
+
+class GroupHandle:
+    def __init__(self, group: ServerGroup, weight: int,
+                 annotations: Optional[HintRule] = None):
+        self.alias = group.alias
+        self.group = group
+        self.weight = weight
+        self.annotations = annotations or HintRule()
+
+    def merged_rule(self) -> HintRule:
+        """Handle annotations take precedence over the group's own
+        (Hint.matchLevel merges in that order, Hint.java:104-117)."""
+        g = self.group.annotations
+        return HintRule(
+            host=self.annotations.host if self.annotations.host is not None else g.host,
+            port=self.annotations.port if self.annotations.port != 0 else g.port,
+            uri=self.annotations.uri if self.annotations.uri is not None else g.uri,
+        )
+
+
+class Upstream:
+    def __init__(self, alias: str, backend: Optional[str] = None):
+        self.alias = alias
+        self.handles: list[GroupHandle] = []
+        self._matcher = HintMatcher([], backend=backend)
+        self._wrr_seq: list[int] = []
+        self._wrr_groups: list[GroupHandle] = []
+        self._wrr_cursor = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- admin
+
+    def add(self, group: ServerGroup, weight: int = 10,
+            annotations: Optional[HintRule] = None) -> GroupHandle:
+        with self._lock:
+            if any(h.group is group for h in self.handles):
+                raise ValueError(f"group {group.alias} already in upstream {self.alias}")
+            h = GroupHandle(group, weight, annotations)
+            self.handles.append(h)
+            self._recalc()
+        return h
+
+    def remove(self, group: ServerGroup) -> None:
+        with self._lock:
+            for i, h in enumerate(self.handles):
+                if h.group is group:
+                    del self.handles[i]
+                    self._recalc()
+                    return
+        raise KeyError(group.alias)
+
+    def set_annotations(self, group: ServerGroup, annotations: HintRule) -> None:
+        with self._lock:
+            for h in self.handles:
+                if h.group is group:
+                    h.annotations = annotations
+                    self._recalc()
+                    return
+        raise KeyError(group.alias)
+
+    def _recalc(self) -> None:
+        self._matcher.set_rules([h.merged_rule() for h in self.handles])
+        groups = [h for h in self.handles if h.weight > 0]
+        self._wrr_groups = groups
+        self._wrr_seq = ServerGroup._wrr_compute(groups) if groups else []
+        self._wrr_cursor = 0
+
+    # ------------------------------------------------------------- data
+
+    def search_for_group(self, hint: Hint) -> Optional[GroupHandle]:
+        idx = self._matcher.match_one(hint)
+        return self.handles[idx] if idx >= 0 else None
+
+    def search_batch(self, hints: Sequence[Hint]) -> list[Optional[GroupHandle]]:
+        return [self.handles[i] if i >= 0 else None
+                for i in self._matcher.match(hints)]
+
+    def seek(self, source_ip: bytes, hint: Hint,
+             fam: Optional[str] = None) -> Optional[Connector]:
+        h = self.search_for_group(hint)
+        if h is not None:
+            return h.group.next(source_ip, fam)
+        return None
+
+    def next(self, source_ip: bytes, hint: Optional[Hint] = None,
+             fam: Optional[str] = None) -> Optional[Connector]:
+        if hint is not None:
+            c = self.seek(source_ip, hint, fam)
+            if c is not None:
+                return c
+        with self._lock:
+            seq, groups = self._wrr_seq, self._wrr_groups
+            for _ in range(len(seq) + 1):
+                if not seq:
+                    return None
+                idx = self._wrr_cursor % len(seq)
+                self._wrr_cursor = idx + 1
+                c = groups[seq[idx]].group.next(source_ip, fam)
+                if c is not None:
+                    return c
+            return None

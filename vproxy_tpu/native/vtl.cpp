@@ -1,0 +1,551 @@
+// vtl — vproxy-tpu host runtime: epoll event loop, nonblocking socket
+// syscall layer, and a native bidirectional splice pump.
+//
+// Role: the C++ equivalent of the reference's native layer (redis-ae event
+// loop dep/ae/ae.c + JNI socket layer vfd_posix_GeneralPosix.c — see
+// SURVEY.md §2.7), redesigned for a Python-orchestrated data plane: Python
+// owns accept/classify/connect decisions; byte shoveling for spliced TCP
+// sessions runs entirely in C (vtl_pump), so the per-byte path never
+// crosses into the interpreter.
+//
+// C ABI only (ctypes-friendly). Level-triggered epoll with explicit
+// interest management.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <unordered_map>
+#include <unordered_set>
+#include <algorithm>
+#include <vector>
+
+#define VTL_EV_READ 1u
+#define VTL_EV_WRITE 2u
+#define VTL_EV_ERROR 4u
+// pump lifecycle notifications delivered through vtl_poll
+#define VTL_EV_PUMP_DONE 8u
+
+extern "C" {
+
+// ---------------------------------------------------------------- sockets
+
+
+static int mk_addr(const char* ip, int port, int v6, sockaddr_storage* ss,
+                   socklen_t* len) {
+  memset(ss, 0, sizeof(*ss));
+  if (v6) {
+    auto* a = (sockaddr_in6*)ss;
+    a->sin6_family = AF_INET6;
+    a->sin6_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET6, ip, &a->sin6_addr) != 1) return -EINVAL;
+    *len = sizeof(sockaddr_in6);
+  } else {
+    auto* a = (sockaddr_in*)ss;
+    a->sin_family = AF_INET;
+    a->sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, ip, &a->sin_addr) != 1) return -EINVAL;
+    *len = sizeof(sockaddr_in);
+  }
+  return 0;
+}
+
+int vtl_tcp_listen(const char* ip, int port, int backlog, int reuseport,
+                   int v6) {
+  int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (reuseport) setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  sockaddr_storage ss;
+  socklen_t len;
+  int r = mk_addr(ip, port, v6, &ss, &len);
+  if (r < 0) { close(fd); return r; }
+  if (bind(fd, (sockaddr*)&ss, len) < 0) { r = -errno; close(fd); return r; }
+  if (listen(fd, backlog) < 0) { r = -errno; close(fd); return r; }
+  return fd;
+}
+
+// returns client fd; fills ip string (INET6_ADDRSTRLEN) and port
+int vtl_accept(int lfd, char* ipbuf, int ipbuflen, int* port) {
+  sockaddr_storage ss;
+  socklen_t len = sizeof(ss);
+  int fd = accept4(lfd, (sockaddr*)&ss, &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
+  if (fd < 0) return -errno;
+  if (ss.ss_family == AF_INET) {
+    auto* a = (sockaddr_in*)&ss;
+    inet_ntop(AF_INET, &a->sin_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin_port);
+  } else {
+    auto* a = (sockaddr_in6*)&ss;
+    inet_ntop(AF_INET6, &a->sin6_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin6_port);
+  }
+  return fd;
+}
+
+int vtl_tcp_connect(const char* ip, int port, int v6) {
+  int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  sockaddr_storage ss;
+  socklen_t len;
+  int r = mk_addr(ip, port, v6, &ss, &len);
+  if (r < 0) { close(fd); return r; }
+  if (connect(fd, (sockaddr*)&ss, len) < 0 && errno != EINPROGRESS) {
+    r = -errno;
+    close(fd);
+    return r;
+  }
+  return fd;
+}
+
+int vtl_finish_connect(int fd) {
+  int err = 0;
+  socklen_t len = sizeof(err);
+  if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) < 0) return -errno;
+  return -err;  // 0 ok, else -errno of the failed connect
+}
+
+int vtl_udp_bind(const char* ip, int port, int v6, int reuseport) {
+  int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (reuseport) setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  sockaddr_storage ss;
+  socklen_t len;
+  int r = mk_addr(ip, port, v6, &ss, &len);
+  if (r < 0) { close(fd); return r; }
+  if (bind(fd, (sockaddr*)&ss, len) < 0) { r = -errno; close(fd); return r; }
+  return fd;
+}
+
+int vtl_udp_socket(int v6) {
+  int fd = socket(v6 ? AF_INET6 : AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+  return fd < 0 ? -errno : fd;
+}
+
+int vtl_recvfrom(int fd, void* buf, int len, char* ipbuf, int ipbuflen,
+                 int* port) {
+  sockaddr_storage ss;
+  socklen_t slen = sizeof(ss);
+  ssize_t n = recvfrom(fd, buf, (size_t)len, 0, (sockaddr*)&ss, &slen);
+  if (n < 0) return -errno;
+  if (ss.ss_family == AF_INET) {
+    auto* a = (sockaddr_in*)&ss;
+    inet_ntop(AF_INET, &a->sin_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin_port);
+  } else if (ss.ss_family == AF_INET6) {
+    auto* a = (sockaddr_in6*)&ss;
+    inet_ntop(AF_INET6, &a->sin6_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin6_port);
+  }
+  return (int)n;
+}
+
+int vtl_sendto(int fd, const void* buf, int len, const char* ip, int port,
+               int v6) {
+  sockaddr_storage ss;
+  socklen_t slen;
+  int r = mk_addr(ip, port, v6, &ss, &slen);
+  if (r < 0) return r;
+  ssize_t n = sendto(fd, buf, (size_t)len, 0, (sockaddr*)&ss, slen);
+  return n < 0 ? -errno : (int)n;
+}
+
+int vtl_read(int fd, void* buf, int len) {
+  ssize_t n = read(fd, buf, (size_t)len);
+  return n < 0 ? -errno : (int)n;
+}
+
+int vtl_write(int fd, const void* buf, int len) {
+  ssize_t n = write(fd, buf, (size_t)len);
+  return n < 0 ? -errno : (int)n;
+}
+
+int vtl_close(int fd) { return close(fd) < 0 ? -errno : 0; }
+
+int vtl_shutdown_wr(int fd) { return shutdown(fd, SHUT_WR) < 0 ? -errno : 0; }
+
+int vtl_set_nodelay(int fd, int on) {
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &on, sizeof(on)) < 0
+             ? -errno : 0;
+}
+
+int vtl_sock_name(int fd, int peer, char* ipbuf, int ipbuflen, int* port) {
+  sockaddr_storage ss;
+  socklen_t len = sizeof(ss);
+  int r = peer ? getpeername(fd, (sockaddr*)&ss, &len)
+               : getsockname(fd, (sockaddr*)&ss, &len);
+  if (r < 0) return -errno;
+  if (ss.ss_family == AF_INET) {
+    auto* a = (sockaddr_in*)&ss;
+    inet_ntop(AF_INET, &a->sin_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin_port);
+  } else {
+    auto* a = (sockaddr_in6*)&ss;
+    inet_ntop(AF_INET6, &a->sin6_addr, ipbuf, ipbuflen);
+    *port = ntohs(a->sin6_port);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- loop
+
+struct Pump;
+
+struct Handler {
+  enum Kind { PY = 0, WAKE = 1, PUMP_A = 2, PUMP_B = 3 } kind;
+  uint64_t tag;   // PY: python tag; PUMP_*: owning pump id
+  Pump* pump;     // PUMP_* only
+  int fd;
+  uint32_t interest;  // current epoll interest (VTL_EV_*)
+};
+
+struct Ring {
+  std::vector<char> buf;
+  size_t head = 0, size = 0;  // ring of buf.size()
+  explicit Ring(size_t cap) : buf(cap) {}
+  size_t cap() const { return buf.size(); }
+  size_t free_() const { return cap() - size; }
+  bool empty() const { return size == 0; }
+  bool full() const { return size == cap(); }
+};
+
+struct Pump {
+  uint64_t id;
+  int fd_a, fd_b;
+  Ring a2b, b2a;
+  bool a_eof = false, b_eof = false;       // read side closed
+  bool a_wr_shut = false, b_wr_shut = false;
+  bool dead = false;
+  int err = 0;
+  uint64_t bytes_a2b = 0, bytes_b2a = 0;
+  Pump(uint64_t i, int a, int b, size_t cap)
+      : id(i), fd_a(a), fd_b(b), a2b(cap), b2a(cap) {}
+};
+
+struct Loop {
+  int ep = -1;
+  int wakefd = -1;
+  std::unordered_map<int, Handler*> handlers;  // by fd
+  std::unordered_map<uint64_t, Pump*> pumps;   // by pump id
+  std::vector<uint64_t> done_pumps;            // report via poll
+  uint64_t next_pump_id = 1;
+  // Handlers can be torn down (pump_kill) while later events in the same
+  // epoll batch still hold their pointers; removals defer the delete and
+  // the poll loop checks membership here before dereferencing.
+  std::unordered_set<Handler*> valid;
+  std::vector<Handler*> garbage;
+};
+
+static void drop_handler(Loop* l, Handler* h) {
+  l->valid.erase(h);
+  l->garbage.push_back(h);
+}
+
+static uint32_t to_ep(uint32_t ev) {
+  uint32_t e = 0;
+  if (ev & VTL_EV_READ) e |= EPOLLIN;
+  if (ev & VTL_EV_WRITE) e |= EPOLLOUT;
+  return e;
+}
+
+static int ep_set(Loop* l, Handler* h, uint32_t interest) {
+  epoll_event e;
+  memset(&e, 0, sizeof(e));
+  e.events = to_ep(interest);
+  e.data.ptr = h;
+  int op = h->interest == (uint32_t)-1 ? EPOLL_CTL_ADD : EPOLL_CTL_MOD;
+  if (epoll_ctl(l->ep, op, h->fd, &e) < 0) return -errno;
+  h->interest = interest;
+  return 0;
+}
+
+void* vtl_new() {
+  Loop* l = new Loop();
+  l->ep = epoll_create1(EPOLL_CLOEXEC);
+  l->wakefd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  Handler* h = new Handler{Handler::WAKE, 0, nullptr, l->wakefd, (uint32_t)-1};
+  l->handlers[l->wakefd] = h;
+  l->valid.insert(h);
+  ep_set(l, h, VTL_EV_READ);
+  return l;
+}
+
+int vtl_wakeup(void* lp) {
+  Loop* l = (Loop*)lp;
+  uint64_t one = 1;
+  ssize_t n = write(l->wakefd, &one, 8);
+  return n == 8 ? 0 : -errno;
+}
+
+int vtl_add(void* lp, int fd, uint32_t events, uint64_t tag) {
+  Loop* l = (Loop*)lp;
+  if (l->handlers.count(fd)) return -EEXIST;
+  Handler* h = new Handler{Handler::PY, tag, nullptr, fd, (uint32_t)-1};
+  int r = ep_set(l, h, events);
+  if (r < 0) { delete h; return r; }
+  l->handlers[fd] = h;
+  l->valid.insert(h);
+  return 0;
+}
+
+int vtl_mod(void* lp, int fd, uint32_t events, uint64_t tag) {
+  Loop* l = (Loop*)lp;
+  auto it = l->handlers.find(fd);
+  if (it == l->handlers.end()) return -ENOENT;
+  it->second->tag = tag;
+  return ep_set(l, it->second, events);
+}
+
+int vtl_del(void* lp, int fd) {
+  Loop* l = (Loop*)lp;
+  auto it = l->handlers.find(fd);
+  if (it == l->handlers.end()) return -ENOENT;
+  epoll_ctl(l->ep, EPOLL_CTL_DEL, fd, nullptr);
+  drop_handler(l, it->second);
+  l->handlers.erase(it);
+  return 0;
+}
+
+// ------------------------------------------------------------ pump engine
+
+static void pump_update_interest(Loop* l, Pump* p);
+
+static void pump_kill(Loop* l, Pump* p, int err) {
+  if (p->dead) return;
+  p->dead = true;
+  p->err = err;
+  for (int fd : {p->fd_a, p->fd_b}) {
+    auto it = l->handlers.find(fd);
+    if (it != l->handlers.end()) {
+      epoll_ctl(l->ep, EPOLL_CTL_DEL, fd, nullptr);
+      drop_handler(l, it->second);
+      l->handlers.erase(it);
+    }
+    close(fd);
+  }
+  l->done_pumps.push_back(p->id);
+}
+
+// move bytes: read src->ring, write ring->dst. returns false on fatal error.
+static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
+                      bool& src_eof, bool& dst_shut, uint64_t& counter) {
+  // write pending data first
+  while (!ring.empty()) {
+    size_t chunk = std::min(ring.size, ring.cap() - ring.head);
+    ssize_t n = write(dst, ring.buf.data() + ring.head, chunk);
+    if (n > 0) {
+      ring.head = (ring.head + (size_t)n) % ring.cap();
+      ring.size -= (size_t)n;
+      counter += (uint64_t)n;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      break;
+    } else {
+      pump_kill(l, p, errno ? errno : EPIPE);
+      return false;
+    }
+  }
+  // then refill from src
+  while (!src_eof && !ring.full()) {
+    size_t tail = (ring.head + ring.size) % ring.cap();
+    size_t chunk = std::min(ring.free_(), ring.cap() - tail);
+    ssize_t n = read(src, ring.buf.data() + tail, chunk);
+    if (n > 0) {
+      ring.size += (size_t)n;
+      // opportunistic immediate write-through (zero-latency splice)
+      while (!ring.empty()) {
+        size_t c2 = std::min(ring.size, ring.cap() - ring.head);
+        ssize_t w = write(dst, ring.buf.data() + ring.head, c2);
+        if (w > 0) {
+          ring.head = (ring.head + (size_t)w) % ring.cap();
+          ring.size -= (size_t)w;
+          counter += (uint64_t)w;
+        } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          pump_kill(l, p, errno ? errno : EPIPE);
+          return false;
+        }
+      }
+    } else if (n == 0) {
+      src_eof = true;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else {
+      pump_kill(l, p, errno);
+      return false;
+    }
+  }
+  // src closed and everything flushed -> propagate FIN
+  if (src_eof && ring.empty() && !dst_shut) {
+    shutdown(dst, SHUT_WR);
+    dst_shut = true;
+  }
+  return true;
+}
+
+static void pump_run(Loop* l, Pump* p) {
+  if (p->dead) return;
+  if (!pump_flow(l, p, p->fd_a, p->fd_b, p->a2b, p->a_eof, p->b_wr_shut,
+                 p->bytes_a2b))
+    return;
+  if (!pump_flow(l, p, p->fd_b, p->fd_a, p->b2a, p->b_eof, p->a_wr_shut,
+                 p->bytes_b2a))
+    return;
+  if (p->a_eof && p->b_eof && p->a2b.empty() && p->b2a.empty()) {
+    pump_kill(l, p, 0);
+    return;
+  }
+  pump_update_interest(l, p);
+}
+
+static void pump_update_interest(Loop* l, Pump* p) {
+  auto ha = l->handlers.find(p->fd_a);
+  auto hb = l->handlers.find(p->fd_b);
+  if (ha == l->handlers.end() || hb == l->handlers.end()) return;
+  uint32_t ia = 0, ib = 0;
+  if (!p->a_eof && !p->a2b.full()) ia |= VTL_EV_READ;
+  if (!p->b2a.empty()) ia |= VTL_EV_WRITE;
+  if (!p->b_eof && !p->b2a.full()) ib |= VTL_EV_READ;
+  if (!p->a2b.empty()) ib |= VTL_EV_WRITE;
+  if (ha->second->interest != ia) ep_set(l, ha->second, ia);
+  if (hb->second->interest != ib) ep_set(l, hb->second, ib);
+}
+
+uint64_t vtl_pump_new(void* lp, int fd_a, int fd_b, int bufsize) {
+  Loop* l = (Loop*)lp;
+  if (l->handlers.count(fd_a) || l->handlers.count(fd_b)) return 0;
+  uint64_t id = l->next_pump_id++;
+  Pump* p = new Pump(id, fd_a, fd_b, (size_t)bufsize);
+  Handler* ha = new Handler{Handler::PUMP_A, id, p, fd_a, (uint32_t)-1};
+  Handler* hb = new Handler{Handler::PUMP_B, id, p, fd_b, (uint32_t)-1};
+  l->handlers[fd_a] = ha;
+  l->handlers[fd_b] = hb;
+  l->valid.insert(ha);
+  l->valid.insert(hb);
+  l->pumps[id] = p;
+  ep_set(l, ha, VTL_EV_READ);
+  ep_set(l, hb, VTL_EV_READ);
+  pump_run(l, p);  // kick: there may be buffered bytes ready to read
+  return id;
+}
+
+// stats: out[0]=bytes_a2b, out[1]=bytes_b2a, out[2]=err, returns 0/-ENOENT
+int vtl_pump_stat(void* lp, uint64_t id, uint64_t* out) {
+  Loop* l = (Loop*)lp;
+  auto it = l->pumps.find(id);
+  if (it == l->pumps.end()) return -ENOENT;
+  out[0] = it->second->bytes_a2b;
+  out[1] = it->second->bytes_b2a;
+  out[2] = (uint64_t)it->second->err;
+  return 0;
+}
+
+int vtl_pump_close(void* lp, uint64_t id) {
+  Loop* l = (Loop*)lp;
+  auto it = l->pumps.find(id);
+  if (it == l->pumps.end()) return -ENOENT;
+  pump_kill(l, it->second, 0);
+  return 0;
+}
+
+// free a DONE pump's memory (after python saw VTL_EV_PUMP_DONE)
+int vtl_pump_free(void* lp, uint64_t id) {
+  Loop* l = (Loop*)lp;
+  auto it = l->pumps.find(id);
+  if (it == l->pumps.end()) return -ENOENT;
+  if (!it->second->dead) pump_kill(l, it->second, 0);
+  delete it->second;
+  l->pumps.erase(it);
+  return 0;
+}
+
+// ------------------------------------------------------------------ poll
+
+int vtl_poll(void* lp, uint64_t* tags, uint32_t* evs, int max,
+             int timeout_ms) {
+  Loop* l = (Loop*)lp;
+  for (Handler* g : l->garbage) delete g;
+  l->garbage.clear();
+  // deliver pending pump-done notifications first
+  int out = 0;
+  auto flush_done = [&]() {
+    while (!l->done_pumps.empty() && out < max) {
+      tags[out] = l->done_pumps.back();
+      evs[out] = VTL_EV_PUMP_DONE;
+      l->done_pumps.pop_back();
+      ++out;
+    }
+  };
+  flush_done();
+  if (out > 0) return out;
+
+  epoll_event eps[256];
+  int cap = 256 < max ? 256 : max;
+  int n = epoll_wait(l->ep, eps, cap, timeout_ms);
+  if (n < 0) return errno == EINTR ? 0 : -errno;
+  for (int i = 0; i < n; ++i) {
+    Handler* h = (Handler*)eps[i].data.ptr;
+    if (!l->valid.count(h)) continue;  // torn down earlier in this batch
+    uint32_t e = eps[i].events;
+    switch (h->kind) {
+      case Handler::WAKE: {
+        uint64_t v;
+        while (read(l->wakefd, &v, 8) == 8) {}
+        break;
+      }
+      case Handler::PY: {
+        uint32_t ve = 0;
+        if (e & (EPOLLIN | EPOLLHUP)) ve |= VTL_EV_READ;
+        if (e & EPOLLOUT) ve |= VTL_EV_WRITE;
+        if (e & EPOLLERR) ve |= VTL_EV_ERROR;
+        if (ve && out < max) {
+          tags[out] = h->tag;
+          evs[out] = ve;
+          ++out;
+        }
+        break;
+      }
+      case Handler::PUMP_A:
+      case Handler::PUMP_B: {
+        Pump* p = h->pump;
+        if (e & EPOLLERR) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(h->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          pump_kill(l, p, err ? err : EIO);
+        } else {
+          pump_run(l, p);
+        }
+        break;
+      }
+    }
+  }
+  flush_done();
+  return out;
+}
+
+void vtl_free(void* lp) {
+  Loop* l = (Loop*)lp;
+  for (Handler* g : l->garbage) delete g;
+  for (auto& kv : l->pumps) delete kv.second;
+  for (auto& kv : l->handlers) delete kv.second;
+  if (l->ep >= 0) close(l->ep);
+  if (l->wakefd >= 0) close(l->wakefd);
+  delete l;
+}
+
+int vtl_errno_eagain() { return EAGAIN; }
+
+}  // extern "C"

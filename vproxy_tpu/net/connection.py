@@ -1,0 +1,196 @@
+"""Connection layer over the event loop.
+
+Functional analog of the reference's connection package (NetEventLoop /
+Connection / ConnectableConnection / ServerSock — connection/*.java):
+nonblocking connections with buffered writes and callback handlers,
+accept loops, and client-side connects with deferred completion. The
+TCP-splice fast path is NOT here — a proxied session detaches both fds
+and hands them to the native pump (eventloop.SelectorEventLoop.pump);
+this layer drives the L7/handler-mode paths (protocol parsing, health
+checks, controllers).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from . import vtl
+from .eventloop import SelectorEventLoop
+
+
+class Handler:
+    """Override some of these; attach with Connection.set_handler."""
+
+    def on_data(self, conn: "Connection", data: bytes) -> None: ...
+
+    def on_eof(self, conn: "Connection") -> None:
+        conn.close()
+
+    def on_closed(self, conn: "Connection", err: int) -> None: ...
+
+    def on_connected(self, conn: "Connection") -> None: ...
+
+    def on_drained(self, conn: "Connection") -> None:
+        """out buffer fully flushed."""
+
+
+class Connection:
+    MAX_OUT = 4 * 1024 * 1024
+
+    def __init__(self, loop: SelectorEventLoop, fd: int, remote, local=None,
+                 connecting: bool = False):
+        self.loop = loop
+        self.fd = fd
+        self.remote = remote  # (ip, port)
+        self.local = local
+        self.handler: Handler = Handler()
+        self.out = bytearray()
+        self.closed = False
+        self.detached = False
+        self.eof_seen = False
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._connecting = connecting
+        self._interest = 0
+        loop.add(fd, 0, self._on_event)
+        self._want(vtl.EV_WRITE if connecting else vtl.EV_READ)
+
+    # ---------------------------------------------------------- public api
+
+    @classmethod
+    def connect(cls, loop: SelectorEventLoop, ip: str, port: int) -> "Connection":
+        fd = vtl.tcp_connect(ip, port)
+        return cls(loop, fd, (ip, port), connecting=True)
+
+    def set_handler(self, h: Handler) -> None:
+        self.handler = h
+
+    def write(self, data: bytes) -> None:
+        if self.closed or self.detached:
+            return
+        self.out += data
+        try:
+            self._flush()
+        except OSError as e:
+            self.close(e.errno or 1)
+            return
+        if len(self.out) > self.MAX_OUT:
+            # backpressure limit blown: the peer has stalled for > MAX_OUT
+            # bytes; kill the session rather than balloon memory
+            self.close(1)
+            return
+        if self.out:
+            self._want(self._interest | vtl.EV_WRITE)
+
+    def close(self, err: int = 0) -> None:
+        if self.closed or self.detached:
+            return
+        self.closed = True
+        self.loop.remove(self.fd)
+        vtl.close(self.fd)
+        self.handler.on_closed(self, err)
+
+    def detach(self) -> int:
+        """Unregister and return the raw fd (for pump handover / transfer)."""
+        if self.closed:
+            raise OSError("closed")
+        self.detached = True
+        self.loop.remove(self.fd)
+        return self.fd
+
+    def pause_reading(self) -> None:
+        self._want(self._interest & ~vtl.EV_READ)
+
+    def resume_reading(self) -> None:
+        self._want(self._interest | vtl.EV_READ)
+
+    # ---------------------------------------------------------- internals
+
+    def _want(self, interest: int) -> None:
+        if self.closed or self.detached:
+            return
+        if interest != self._interest:
+            self.loop.modify(self.fd, interest)
+            self._interest = interest
+
+    def _flush(self) -> None:
+        while self.out:
+            n = vtl.write(self.fd, bytes(self.out[:262144]))
+            if n == vtl.AGAIN:
+                return
+            if n <= 0:
+                return
+            self.bytes_out += n
+            del self.out[:n]
+
+    def _on_event(self, fd: int, ev: int) -> None:
+        try:
+            self._on_event_inner(fd, ev)
+        except OSError as e:
+            # peer reset / broken pipe etc. -> close this connection only
+            self.close(e.errno or 1)
+
+    def _on_event_inner(self, fd: int, ev: int) -> None:
+        if self.closed or self.detached:
+            return
+        if self._connecting:
+            self._connecting = False
+            err = vtl.finish_connect(fd)
+            if err != 0:
+                self.close(-err)
+                return
+            self._want(vtl.EV_READ)
+            self.handler.on_connected(self)
+            if self.out:
+                self._flush()
+                if self.out:
+                    self._want(self._interest | vtl.EV_WRITE)
+            return
+        if ev & vtl.EV_ERROR:
+            self.close(vtl.finish_connect(fd) or 1)
+            return
+        if ev & vtl.EV_READ:
+            while not (self.closed or self.detached):
+                data = vtl.read(self.fd)
+                if data is None:  # EAGAIN
+                    break
+                if data == b"":
+                    self.eof_seen = True
+                    self._want(self._interest & ~vtl.EV_READ)
+                    self.handler.on_eof(self)
+                    break
+                self.bytes_in += len(data)
+                self.handler.on_data(self, data)
+        if (ev & vtl.EV_WRITE) and not (self.closed or self.detached):
+            self._flush()
+            if not self.out:
+                self._want(self._interest & ~vtl.EV_WRITE)
+                self.handler.on_drained(self)
+
+
+class ServerSock:
+    def __init__(self, loop: SelectorEventLoop, ip: str, port: int,
+                 on_accept: Callable[[int, str, int], None],
+                 backlog: int = 512, reuseport: bool = False):
+        self.loop = loop
+        self.ip, self.port = ip, port
+        self.fd = vtl.tcp_listen(ip, port, backlog, reuseport, ":" in ip)
+        self.on_accept = on_accept
+        self.closed = False
+        loop.add(self.fd, vtl.EV_READ, self._on_event)
+        if port == 0:
+            _, self.port = vtl.sock_name(self.fd)
+
+    def _on_event(self, fd: int, ev: int) -> None:
+        while not self.closed:
+            r = vtl.accept(self.fd)
+            if r is None:
+                break
+            cfd, ip, port = r
+            self.on_accept(cfd, ip, port)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.loop.remove(self.fd)
+        vtl.close(self.fd)

@@ -1,0 +1,247 @@
+"""SelectorEventLoop — the per-core scheduler.
+
+Functional equivalent of the reference's selector/SelectorEventLoop.java
+(poll loop :265-322, timer queue :159-168, cross-thread task queue
+:370-389, wakeups, loop-thread confinement): a single thread polls the
+native epoll loop; all state mutation happens on that thread; other
+threads submit closures via run_on_loop() + eventfd wakeup. Timers are a
+heapq; the poll timeout is the nearest deadline (same single-clock
+design — one coarse timestamp per tick).
+
+The native splice pump (net/vtl.py pump_*) is the handleDirect fast
+path: once a session enters TCP-splice mode both fds are handed to C++
+and Python only sees the PUMP_DONE lifecycle event.
+"""
+from __future__ import annotations
+
+import ctypes
+import heapq
+import itertools
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Optional
+
+from . import vtl
+
+MAX_EVENTS = 256
+
+
+def _guard(fn, *args) -> None:
+    """Run a callback; a failing handler must never kill the loop thread
+    (the reference logs and survives — Logger error paths in
+    SelectorEventLoop.doHandling)."""
+    try:
+        fn(*args)
+    except Exception:
+        traceback.print_exc()
+
+
+class TimerEvent:
+    __slots__ = ("deadline", "fn", "cancelled", "seq")
+
+    def __init__(self, deadline: float, fn: Callable[[], None], seq: int):
+        self.deadline = deadline
+        self.fn = fn
+        self.cancelled = False
+        self.seq = seq
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other: "TimerEvent") -> bool:
+        return (self.deadline, self.seq) < (other.deadline, other.seq)
+
+
+class PeriodicEvent:
+    __slots__ = ("loop", "interval_ms", "fn", "_timer", "_stopped")
+
+    def __init__(self, loop: "SelectorEventLoop", interval_ms: int, fn):
+        self.loop = loop
+        self.interval_ms = interval_ms
+        self.fn = fn
+        self._stopped = False
+        self._schedule()
+
+    def _schedule(self) -> None:
+        if self._stopped:
+            return
+        self._timer = self.loop.delay(self.interval_ms, self._fire)
+
+    def _fire(self) -> None:
+        if self._stopped:
+            return
+        try:
+            self.fn()
+        finally:
+            self._schedule()
+
+    def cancel(self) -> None:
+        self._stopped = True
+        t = getattr(self, "_timer", None)
+        if t is not None:
+            t.cancel()
+
+
+class SelectorEventLoop:
+    def __init__(self, name: str = "loop"):
+        self.name = name
+        self._lp = vtl.LIB.vtl_new()
+        self._handlers: dict[int, tuple[int, Callable]] = {}  # tag -> (fd, cb)
+        self._fd_tags: dict[int, int] = {}  # fd -> tag
+        self._pump_cbs: dict[int, Callable] = {}  # pump id -> on_done
+        self._timers: list[TimerEvent] = []
+        self._tick_q: deque = deque()
+        self._xq: deque = deque()  # cross-thread queue
+        self._xq_lock = threading.Lock()
+        self._seq = itertools.count()
+        self._taggen = itertools.count(1)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self.now = time.monotonic()
+        self._tags_buf = (ctypes.c_uint64 * MAX_EVENTS)()
+        self._evs_buf = (ctypes.c_uint32 * MAX_EVENTS)()
+
+    # ------------------------------------------------------------ registry
+
+    def add(self, fd: int, events: int, cb: Callable[[int, int], None]) -> None:
+        """cb(fd, events) fires on readiness. Loop thread only."""
+        tag = next(self._taggen)
+        vtl.check(vtl.LIB.vtl_add(self._lp, fd, events, tag))
+        self._handlers[tag] = (fd, cb)
+        self._fd_tags[fd] = tag
+
+    def modify(self, fd: int, events: int) -> None:
+        tag = self._fd_tags[fd]
+        vtl.check(vtl.LIB.vtl_mod(self._lp, fd, events, tag))
+
+    def remove(self, fd: int) -> None:
+        tag = self._fd_tags.pop(fd, None)
+        if tag is None:
+            return
+        vtl.LIB.vtl_del(self._lp, fd)
+        self._handlers.pop(tag, None)
+
+    def registered(self, fd: int) -> bool:
+        return fd in self._fd_tags
+
+    # ------------------------------------------------------------ pumps
+
+    def pump(self, fd_a: int, fd_b: int, bufsize: int = 65536,
+             on_done: Optional[Callable[[int, int, int], None]] = None) -> int:
+        """Hand both fds to the native splice engine. The loop owns the fds
+        from here; on_done(bytes_a2b, bytes_b2a, err) fires when the session
+        dies. Any python registration for these fds must be removed first."""
+        pid = vtl.LIB.vtl_pump_new(self._lp, fd_a, fd_b, bufsize)
+        if pid == 0:
+            raise OSError("pump: fds busy")
+        self._pump_cbs[pid] = on_done
+        return pid
+
+    def pump_close(self, pump_id: int) -> None:
+        vtl.LIB.vtl_pump_close(self._lp, pump_id)
+
+    def pump_stat(self, pump_id: int):
+        out = (ctypes.c_uint64 * 3)()
+        vtl.check(vtl.LIB.vtl_pump_stat(self._lp, pump_id, out))
+        return int(out[0]), int(out[1]), int(out[2])
+
+    # ------------------------------------------------------------ timers
+
+    def next_tick(self, fn: Callable[[], None]) -> None:
+        self._tick_q.append(fn)
+
+    def run_on_loop(self, fn: Callable[[], None]) -> None:
+        """Thread-safe submit + wakeup."""
+        if threading.current_thread() is self._thread:
+            self.next_tick(fn)
+            return
+        with self._xq_lock:
+            self._xq.append(fn)
+        vtl.LIB.vtl_wakeup(self._lp)
+
+    def delay(self, ms: int, fn: Callable[[], None]) -> TimerEvent:
+        t = TimerEvent(time.monotonic() + ms / 1000.0, fn, next(self._seq))
+        heapq.heappush(self._timers, t)
+        return t
+
+    def period(self, ms: int, fn: Callable[[], None]) -> PeriodicEvent:
+        return PeriodicEvent(self, ms, fn)
+
+    # ------------------------------------------------------------ loop
+
+    def _run_queues(self) -> None:
+        if self._xq:
+            with self._xq_lock:
+                items, self._xq = self._xq, deque()
+            for fn in items:
+                _guard(fn)
+        while self._tick_q:
+            _guard(self._tick_q.popleft())
+
+    def _run_timers(self) -> None:
+        now = time.monotonic()
+        self.now = now
+        while self._timers and self._timers[0].deadline <= now:
+            t = heapq.heappop(self._timers)
+            if not t.cancelled:
+                _guard(t.fn)
+
+    def _next_timeout_ms(self) -> int:
+        while self._timers and self._timers[0].cancelled:
+            heapq.heappop(self._timers)
+        if self._tick_q or self._xq:
+            return 0
+        if not self._timers:
+            return 1000
+        ms = int((self._timers[0].deadline - time.monotonic()) * 1000)
+        return max(ms, 0)
+
+    def one_poll(self) -> None:
+        self._run_queues()
+        self._run_timers()
+        n = vtl.LIB.vtl_poll(self._lp, self._tags_buf, self._evs_buf,
+                             MAX_EVENTS, self._next_timeout_ms())
+        if n < 0:
+            raise OSError(-n, "vtl_poll")
+        self.now = time.monotonic()
+        for i in range(n):
+            tag, ev = self._tags_buf[i], self._evs_buf[i]
+            if ev & vtl.EV_PUMP_DONE:
+                cb = self._pump_cbs.pop(tag, None)
+                a2b, b2a, err = self.pump_stat(tag)
+                vtl.LIB.vtl_pump_free(self._lp, tag)
+                if cb is not None:
+                    _guard(cb, a2b, b2a, err)
+                continue
+            ent = self._handlers.get(tag)
+            if ent is not None:
+                fd, cb = ent
+                _guard(cb, fd, ev)
+        self._run_queues()
+        self._run_timers()
+
+    def loop(self) -> None:
+        self._thread = threading.current_thread()
+        while not self._closed:
+            self.one_poll()
+
+    def loop_thread(self) -> threading.Thread:
+        th = threading.Thread(target=self.loop, name=self.name, daemon=True)
+        self._thread = th
+        th.start()
+        return th
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None and self._thread is not threading.current_thread():
+            vtl.LIB.vtl_wakeup(self._lp)
+            self._thread.join(timeout=5)
+        for fd in list(self._fd_tags):
+            self.remove(fd)
+            vtl.close(fd)
+        vtl.LIB.vtl_free(self._lp)
+        self._lp = None

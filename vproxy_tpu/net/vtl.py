@@ -1,0 +1,161 @@
+"""ctypes binding for the native host runtime (native/vtl.cpp).
+
+Auto-builds libvtl.so on first import if missing (make in
+vproxy_tpu/native). All fd-returning calls raise OSError on negative
+return; I/O calls return -EAGAIN as the sentinel AGAIN instead of
+raising (hot path).
+"""
+from __future__ import annotations
+
+import ctypes
+import errno
+import os
+import subprocess
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO = os.path.join(_DIR, "libvtl.so")
+
+EV_READ = 1
+EV_WRITE = 2
+EV_ERROR = 4
+EV_PUMP_DONE = 8
+
+AGAIN = -errno.EAGAIN
+
+
+def _build() -> None:
+    subprocess.run(["make", "-s"], cwd=_DIR, check=True)
+
+
+def _load() -> ctypes.CDLL:
+    src = os.path.join(_DIR, "vtl.cpp")
+    if not os.path.exists(_SO) or (
+            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_SO)):
+        _build()
+    lib = ctypes.CDLL(_SO)
+    c = ctypes.c_int
+    p = ctypes.c_void_p
+    u64 = ctypes.c_uint64
+    lib.vtl_new.restype = p
+    lib.vtl_free.argtypes = [p]
+    lib.vtl_wakeup.argtypes = [p]
+    lib.vtl_add.argtypes = [p, c, ctypes.c_uint32, u64]
+    lib.vtl_mod.argtypes = [p, c, ctypes.c_uint32, u64]
+    lib.vtl_del.argtypes = [p, c]
+    lib.vtl_poll.argtypes = [p, ctypes.POINTER(u64), ctypes.POINTER(ctypes.c_uint32), c, c]
+    lib.vtl_tcp_listen.argtypes = [ctypes.c_char_p, c, c, c, c]
+    lib.vtl_accept.argtypes = [c, ctypes.c_char_p, c, ctypes.POINTER(c)]
+    lib.vtl_tcp_connect.argtypes = [ctypes.c_char_p, c, c]
+    lib.vtl_finish_connect.argtypes = [c]
+    lib.vtl_udp_bind.argtypes = [ctypes.c_char_p, c, c, c]
+    lib.vtl_udp_socket.argtypes = [c]
+    lib.vtl_recvfrom.argtypes = [c, p, c, ctypes.c_char_p, c, ctypes.POINTER(c)]
+    lib.vtl_sendto.argtypes = [c, p, c, ctypes.c_char_p, c, c]
+    lib.vtl_read.argtypes = [c, p, c]
+    lib.vtl_write.argtypes = [c, p, c]
+    lib.vtl_close.argtypes = [c]
+    lib.vtl_shutdown_wr.argtypes = [c]
+    lib.vtl_set_nodelay.argtypes = [c, c]
+    lib.vtl_sock_name.argtypes = [c, c, ctypes.c_char_p, c, ctypes.POINTER(c)]
+    lib.vtl_pump_new.argtypes = [p, c, c, c]
+    lib.vtl_pump_new.restype = u64
+    lib.vtl_pump_stat.argtypes = [p, u64, ctypes.POINTER(u64)]
+    lib.vtl_pump_close.argtypes = [p, u64]
+    lib.vtl_pump_free.argtypes = [p, u64]
+    return lib
+
+
+LIB = _load()
+
+
+def check(r: int) -> int:
+    if r < 0:
+        raise OSError(-r, os.strerror(-r))
+    return r
+
+
+def tcp_listen(ip: str, port: int, backlog: int = 512, reuseport: bool = False,
+               v6: bool = False) -> int:
+    return check(LIB.vtl_tcp_listen(ip.encode(), port, backlog,
+                                    1 if reuseport else 0, 1 if v6 else 0))
+
+
+def accept(lfd: int):
+    """-> (fd, ip, port) or None on EAGAIN."""
+    buf = ctypes.create_string_buffer(64)
+    port = ctypes.c_int(0)
+    fd = LIB.vtl_accept(lfd, buf, 64, ctypes.byref(port))
+    if fd == AGAIN:
+        return None
+    check(fd)
+    return fd, buf.value.decode(), port.value
+
+
+def tcp_connect(ip: str, port: int) -> int:
+    return check(LIB.vtl_tcp_connect(ip.encode(), port, 1 if ":" in ip else 0))
+
+
+def finish_connect(fd: int) -> int:
+    return LIB.vtl_finish_connect(fd)  # 0 ok else -errno
+
+
+def udp_bind(ip: str, port: int, reuseport: bool = False) -> int:
+    return check(LIB.vtl_udp_bind(ip.encode(), port, 1 if ":" in ip else 0,
+                                  1 if reuseport else 0))
+
+
+def udp_socket(v6: bool = False) -> int:
+    return check(LIB.vtl_udp_socket(1 if v6 else 0))
+
+
+def recvfrom(fd: int, n: int = 65536):
+    """-> (data, ip, port) or None on EAGAIN."""
+    buf = ctypes.create_string_buffer(n)
+    ipb = ctypes.create_string_buffer(64)
+    port = ctypes.c_int(0)
+    r = LIB.vtl_recvfrom(fd, buf, n, ipb, 64, ctypes.byref(port))
+    if r == AGAIN:
+        return None
+    check(r)
+    return buf.raw[:r], ipb.value.decode(), port.value
+
+
+def sendto(fd: int, data: bytes, ip: str, port: int) -> int:
+    r = LIB.vtl_sendto(fd, data, len(data), ip.encode(), port,
+                       1 if ":" in ip else 0)
+    return r if r == AGAIN else check(r)
+
+
+def read(fd: int, n: int = 65536):
+    """-> bytes (b'' on EOF) or None on EAGAIN."""
+    buf = ctypes.create_string_buffer(n)
+    r = LIB.vtl_read(fd, buf, n)
+    if r == AGAIN:
+        return None
+    check(r)
+    return buf.raw[:r]
+
+
+def write(fd: int, data: bytes) -> int:
+    """-> bytes written, or AGAIN (<0)."""
+    r = LIB.vtl_write(fd, data, len(data))
+    return r if r == AGAIN else check(r)
+
+
+def close(fd: int) -> None:
+    LIB.vtl_close(fd)
+
+
+def shutdown_wr(fd: int) -> None:
+    LIB.vtl_shutdown_wr(fd)
+
+
+def set_nodelay(fd: int, on: bool = True) -> None:
+    LIB.vtl_set_nodelay(fd, 1 if on else 0)
+
+
+def sock_name(fd: int, peer: bool = False):
+    buf = ctypes.create_string_buffer(64)
+    port = ctypes.c_int(0)
+    check(LIB.vtl_sock_name(fd, 1 if peer else 0, buf, 64, ctypes.byref(port)))
+    return buf.value.decode(), port.value

@@ -1,0 +1,93 @@
+"""HTTP/1.x request-head parsing for backend selection.
+
+Round-1 scope of the reference's http1 processor
+(processor/http1/HttpSubContext.java, 849-line char state machine): an
+incremental head parser that extracts method/URI/Host from the first
+request so the LB can build a Hint (HttpContext.java:63-69 — hint =
+host [+ uri]), after which the session is spliced. Per-request
+re-routing on a kept-alive connection (full processor SPI) is the next
+iteration.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..rules.ir import Hint
+
+MAX_HEAD = 64 * 1024
+
+
+class HeadParser:
+    """Feed bytes; .done becomes True when the full head (incl. CRLFCRLF)
+    has been seen or .error is set."""
+
+    def __init__(self) -> None:
+        self.buf = bytearray()
+        self.done = False
+        self.error: Optional[str] = None
+        self.method: Optional[str] = None
+        self.uri: Optional[str] = None
+        self.version: Optional[str] = None
+        self.headers: list[tuple[str, str]] = []
+
+    def feed(self, data: bytes) -> None:
+        if self.done or self.error:
+            return
+        self.buf += data
+        if len(self.buf) > MAX_HEAD:
+            self.error = "head too large"
+            return
+        end = self.buf.find(b"\r\n\r\n")
+        if end < 0:
+            # tolerate bare-LF heads
+            end_lf = self.buf.find(b"\n\n")
+            if end_lf < 0:
+                return
+            head = bytes(self.buf[:end_lf])
+            self._parse(head, end_lf + 2)
+            return
+        self._parse(bytes(self.buf[:end]), end + 4)
+
+    def _parse(self, head: bytes, head_len: int) -> None:
+        lines = head.replace(b"\r\n", b"\n").split(b"\n")
+        try:
+            req = lines[0].decode("latin-1")
+            parts = req.split()
+            if len(parts) < 2:
+                self.error = "bad request line"
+                return
+            self.method = parts[0]
+            self.uri = parts[1]
+            self.version = parts[2] if len(parts) > 2 else "HTTP/1.0"
+        except Exception:
+            self.error = "bad request line"
+            return
+        for ln in lines[1:]:
+            if not ln:
+                continue
+            i = ln.find(b":")
+            if i < 0:
+                continue
+            k = ln[:i].strip().decode("latin-1").lower()
+            v = ln[i + 1:].strip().decode("latin-1")
+            self.headers.append((k, v))
+        self.head_len = head_len
+        self.done = True
+
+    def header(self, name: str) -> Optional[str]:
+        for k, v in self.headers:
+            if k == name:
+                return v
+        return None
+
+    def hint(self) -> Optional[Hint]:
+        if not self.done:
+            return None
+        host = self.header("host")
+        if host is not None and self.uri is not None:
+            return Hint.of_host_uri(host, self.uri)
+        if host is not None:
+            return Hint.of_host(host)
+        if self.uri is not None:
+            return Hint.of_uri(self.uri)
+        return None

@@ -32,6 +32,13 @@ def default_backend() -> str:
     return os.environ.get("VPROXY_TPU_MATCHER", "jax")
 
 
+# Below this rule count, single (unbatched) queries run on the host oracle:
+# a python scan over a handful of rules is ~1us while a device dispatch is
+# ~1ms — the device path wins only for big tables or batched queries. The
+# device table is still compiled and kept in sync (used by match() batches).
+SMALL_TABLE = int(os.environ.get("VPROXY_TPU_SMALL_TABLE", "128"))
+
+
 def _to_device(arrs: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -84,6 +91,8 @@ class HintMatcher:
         return np.asarray(idx)
 
     def match_one(self, hint: Hint) -> int:
+        if self.backend == "jax" and len(self._rules) <= SMALL_TABLE:
+            return oracle.search(self._rules, hint)
         return int(self.match([hint])[0])
 
 
@@ -119,15 +128,9 @@ class CidrMatcher:
         if not self._nets or not addrs:
             return np.full(len(addrs), -1, np.int32)
         if self.backend == "host":
-            out = np.full(len(addrs), -1, np.int32)
-            for i, a in enumerate(addrs):
-                for j, net in enumerate(self._nets):
-                    if net.contains_ip(a) and (
-                            ports is None or self._acl is None or
-                            (self._acl[j].min_port <= ports[i] <= self._acl[j].max_port)):
-                        out[i] = j
-                        break
-            return out
+            return np.array(
+                [self._scan_one(a, None if ports is None else ports[i])
+                 for i, a in enumerate(addrs)], np.int32)
         a16, fam = T.encode_ips(addrs)
         # route tables (acl=None) have zeroed port-range columns: the port
         # gate must be skipped entirely or every port>0 query misses
@@ -135,5 +138,15 @@ class CidrMatcher:
         idx = cidr_match_jit(self._dev, a16, fam, p)
         return np.asarray(idx)
 
+    def _scan_one(self, addr: bytes, port: Optional[int]) -> int:
+        for j, net in enumerate(self._nets):
+            if net.contains_ip(addr) and (
+                    port is None or self._acl is None or
+                    (self._acl[j].min_port <= port <= self._acl[j].max_port)):
+                return j
+        return -1
+
     def match_one(self, addr: bytes, port: Optional[int] = None) -> int:
+        if self.backend == "jax" and len(self._nets) <= SMALL_TABLE:
+            return self._scan_one(addr, port)
         return int(self.match([addr], None if port is None else [port])[0])
