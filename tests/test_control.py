@@ -1,0 +1,123 @@
+"""Control plane: command grammar end-to-end + config save/load round-trip.
+
+Pattern follows the reference CI suite (ci/CI.java): boot the real app,
+drive it exactly like an operator, then hit the provisioned LBs."""
+import socket
+import time
+
+import pytest
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import CmdError, Command
+from vproxy_tpu.control import persist
+
+from test_tcplb import IdServer, wait_healthy, tcp_get_id, http_get_id
+
+
+@pytest.fixture
+def app():
+    a = Application.create(workers=1)
+    yield a
+    a.close()
+
+
+def run(app, line):
+    return Command.execute(app, line)
+
+
+def test_command_crud_and_traffic(app, tmp_path):
+    s1, s2 = IdServer("A", http=True), IdServer("B", http=True)
+    try:
+        run(app, "add upstream ups0")
+        run(app, "add server-group sg0 timeout 500 period 100 up 1 down 1 method wrr")
+        run(app, f"add server svr0 to server-group sg0 address 127.0.0.1:{s1.port} weight 10")
+        run(app, "add server-group sg1 timeout 500 period 100 up 1 down 1")
+        run(app, f"add server svr0 to server-group sg1 address 127.0.0.1:{s2.port} weight 10")
+        run(app, 'add server-group sg0 to upstream ups0 weight 10 annotations '
+                 '{"vproxy/hint-host":"a.example.com"}')
+        run(app, 'add server-group sg1 to upstream ups0 weight 10 annotations '
+                 '{"vproxy/hint-host":"b.example.com"}')
+        assert run(app, "list server-group") == ["sg0", "sg1"]
+        assert run(app, "list server-group in upstream ups0") == ["sg0", "sg1"]
+        assert run(app, "l ups") == ["ups0"]
+        detail = run(app, "list-detail server in server-group sg0")
+        assert "connect-to 127.0.0.1" in detail[0]
+
+        wait_healthy(app.server_groups["sg0"], 1)
+        wait_healthy(app.server_groups["sg1"], 1)
+        run(app, "add tcp-lb lb0 address 127.0.0.1:0 upstream ups0 protocol http")
+        port = app.tcp_lbs["lb0"].bind_port
+        _, body = http_get_id(port, "a.example.com")
+        assert body == "A"
+        _, body = http_get_id(port, "b.example.com")
+        assert body == "B"
+        # stats channels
+        assert int(run(app, "list accepted-conn-count in tcp-lb lb0")[0]) >= 2
+
+        # abbreviations + update
+        run(app, "u sg sg0 method wlc")
+        assert app.server_groups["sg0"].method == "wlc"
+        run(app, "update server-group sg0 in upstream ups0 weight 5")
+
+        # dependency protection
+        with pytest.raises(CmdError):
+            run(app, "remove upstream ups0")
+        with pytest.raises(CmdError):
+            run(app, "remove server-group sg0")
+
+        # config round-trip
+        cfg = persist.current_config(app)
+        assert "add tcp-lb lb0" in cfg and "vproxy/hint-host" in cfg
+        p = tmp_path / "cfg"
+        persist.save(app, str(p))
+
+        run(app, "remove tcp-lb lb0")
+        run(app, "remove server-group sg0 from upstream ups0")
+        run(app, "remove server-group sg1 from upstream ups0")
+        run(app, "force-remove upstream ups0")
+        run(app, "force-remove server-group sg0")
+        run(app, "force-remove server-group sg1")
+        assert run(app, "list tcp-lb") == []
+
+        # reload brings everything back (new ephemeral port though: the lb
+        # was saved with its concrete port, so it rebinds the same one)
+        n = persist.load(app, str(p))
+        assert n >= 8
+        wait_healthy(app.server_groups["sg0"], 1)
+        _, body = http_get_id(app.tcp_lbs["lb0"].bind_port, "a.example.com")
+        assert body == "A"
+    finally:
+        s1.close()
+        s2.close()
+
+
+def test_command_errors(app):
+    with pytest.raises(CmdError):
+        run(app, "bogus tcp-lb x")
+    with pytest.raises(CmdError):
+        run(app, "add tcp-lb")  # missing alias
+    with pytest.raises(CmdError):
+        run(app, "add tcp-lb lb0 address 127.0.0.1:0 upstream nope")
+    with pytest.raises(CmdError):
+        run(app, "add server svr0 to server-group missing address 1.2.3.4:80")
+    with pytest.raises(CmdError):
+        run(app, "add security-group s default maybe")
+    run(app, "add security-group secg0 default deny")
+    with pytest.raises(CmdError):
+        run(app, "add security-group secg0 default allow")  # dup
+    run(app, "add security-group-rule r0 to security-group secg0 "
+             "network 10.0.0.0/8 protocol tcp port-range 1,1024 default allow")
+    out = run(app, "list-detail security-group-rule in security-group secg0")
+    assert "10.0.0.0/8" in out[0]
+
+
+def test_event_loop_management(app):
+    run(app, "add event-loop-group elg0")
+    run(app, "add event-loop el0 to event-loop-group elg0")
+    run(app, "add event-loop el1 to event-loop-group elg0")
+    assert run(app, "list event-loop in event-loop-group elg0") == ["el0", "el1"]
+    run(app, "remove event-loop el0 from event-loop-group elg0")
+    assert run(app, "list event-loop in event-loop-group elg0") == ["el1"]
+    with pytest.raises(CmdError):
+        run(app, "remove event-loop-group (worker-elg)")
+    run(app, "remove event-loop-group elg0")

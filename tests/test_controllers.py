@@ -1,0 +1,162 @@
+"""RESP + HTTP controllers end-to-end over real sockets (CI.java pattern:
+drive the app like an operator — redis-style client + REST client)."""
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.http_controller import HttpController
+from vproxy_tpu.control.resp import RESPController
+
+from test_tcplb import IdServer, wait_healthy, http_get_id
+
+
+@pytest.fixture
+def app():
+    a = Application.create(workers=1)
+    yield a
+    a.close()
+
+
+class RespClient:
+    def __init__(self, port):
+        self.c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.c.settimeout(5)
+        self.buf = b""
+
+    def cmd(self, *args):
+        out = b"*%d\r\n" % len(args)
+        for a in args:
+            b = str(a).encode()
+            out += b"$%d\r\n%s\r\n" % (len(b), b)
+        self.c.sendall(out)
+        return self._read_reply()
+
+    def _need(self, n):
+        while len(self.buf) < n:
+            d = self.c.recv(65536)
+            if not d:
+                raise EOFError()
+            self.buf += d
+
+    def _line(self):
+        while b"\r\n" not in self.buf:
+            self._need(len(self.buf) + 1)
+        line, _, self.buf = self.buf.partition(b"\r\n")
+        return line
+
+    def _read_reply(self):
+        self._need(1)
+        t = self.buf[0:1]
+        if t in (b"+", b"-", b":"):
+            line = self._line()
+            if t == b"-":
+                raise RuntimeError(line[1:].decode())
+            return line[1:].decode()
+        if t == b"$":
+            n = int(self._line()[1:])
+            if n < 0:
+                return None
+            self._need(n + 2)
+            data = self.buf[:n]
+            self.buf = self.buf[n + 2:]
+            return data.decode()
+        if t == b"*":
+            n = int(self._line()[1:])
+            return [self._read_reply() for _ in range(n)]
+        raise RuntimeError(f"bad reply {t}")
+
+    def close(self):
+        self.c.close()
+
+
+def test_resp_controller_full_flow(app):
+    ctl = RESPController(app, "127.0.0.1", 0, password="sekret")
+    ctl.start()
+    backend = IdServer("R1", http=True)
+    try:
+        cli = RespClient(ctl.bind_port)
+        assert cli.cmd("ping") == "PONG"
+        with pytest.raises(RuntimeError, match="NOAUTH"):
+            cli.cmd("list", "upstream")
+        assert cli.cmd("auth", "sekret") == "OK"
+        assert cli.cmd("add", "upstream", "ups0") == "OK"
+        assert cli.cmd("add", "server-group", "sg0", "timeout", "500",
+                       "period", "100", "up", "1", "down", "1") == "OK"
+        assert cli.cmd("add", "server", "s1", "to", "server-group", "sg0",
+                       "address", f"127.0.0.1:{backend.port}") == "OK"
+        assert cli.cmd("add", "server-group", "sg0", "to", "upstream", "ups0",
+                       "weight", "10") == "OK"
+        wait_healthy(app.server_groups["sg0"], 1)
+        assert cli.cmd("add", "tcp-lb", "lb0", "address", "127.0.0.1:0",
+                       "upstream", "ups0", "protocol", "http") == "OK"
+        port = app.tcp_lbs["lb0"].bind_port
+        _, body = http_get_id(port, "x.io")
+        assert body == "R1"
+        assert cli.cmd("list", "tcp-lb") == ["lb0"]
+        detail = cli.cmd("list-detail", "server", "in", "server-group", "sg0")
+        assert "currently UP" in detail[0]
+        with pytest.raises(RuntimeError, match="not found"):
+            cli.cmd("remove", "tcp-lb", "nope")
+        cli.close()
+    finally:
+        backend.close()
+        ctl.stop()
+
+
+def http_req(port, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"null")
+
+
+def test_http_controller_crud(app):
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    backend = IdServer("H1", http=True)
+    try:
+        st, _ = http_req(ctl.bind_port, "GET", "/healthz")
+        assert st == 200
+        st, r = http_req(ctl.bind_port, "POST", "/api/v1/module/upstream",
+                         {"name": "ups0"})
+        assert st == 200 and r["result"] == "OK"
+        st, r = http_req(ctl.bind_port, "POST", "/api/v1/module/server-group",
+                         {"name": "sg0", "timeout": 500, "period": 100,
+                          "up": 1, "down": 1})
+        assert st == 200
+        st, r = http_req(ctl.bind_port, "POST",
+                         "/api/v1/module/server-group/sg0/server",
+                         {"name": "s1", "address": f"127.0.0.1:{backend.port}"})
+        assert st == 200
+        st, r = http_req(ctl.bind_port, "POST", "/api/v1/command",
+                         {"command": "add server-group sg0 to upstream ups0 weight 10"})
+        assert st == 200
+        wait_healthy(app.server_groups["sg0"], 1)
+        st, r = http_req(ctl.bind_port, "POST", "/api/v1/module/tcp-lb",
+                         {"name": "lb0", "address": "127.0.0.1:0",
+                          "upstream": "ups0", "protocol": "http"})
+        assert st == 200
+        _, body = http_get_id(app.tcp_lbs["lb0"].bind_port, "y.io")
+        assert body == "H1"
+        st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/tcp-lb")
+        assert st == 200 and any("lb0" in line for line in r)
+        st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/server-group/sg0/server")
+        assert st == 200 and "currently UP" in r[0]
+        st, r = http_req(ctl.bind_port, "DELETE", "/api/v1/module/tcp-lb/lb0")
+        assert st == 200
+        assert app.tcp_lbs == {}
+        st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/nope")
+        assert st == 404
+        st, r = http_req(ctl.bind_port, "POST", "/api/v1/module/tcp-lb",
+                         {"name": "bad", "address": "127.0.0.1:0", "upstream": "missing"})
+        assert st == 400 and "not found" in r["error"]
+    finally:
+        backend.close()
+        ctl.stop()

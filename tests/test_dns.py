@@ -1,0 +1,145 @@
+"""DNS codec round-trips + DNSServer end-to-end over real UDP."""
+import socket
+import time
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.servergroup import HealthCheckConfig, ServerGroup
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.dns import packet as P
+from vproxy_tpu.dns.client import DNSClient
+from vproxy_tpu.dns.server import DNSServer
+from vproxy_tpu.rules.ir import HintRule
+from vproxy_tpu.utils.ip import parse_ip
+
+from test_tcplb import IdServer, fast_hc, wait_healthy  # reuse fixtures
+
+
+def test_codec_roundtrip():
+    pkt = P.Packet(id=0x1234, rd=True, questions=[P.Question("x.example.com.", P.A)])
+    enc = pkt.encode()
+    back = P.parse(enc)
+    assert back.id == 0x1234 and back.questions[0].qname == "x.example.com."
+    resp = P.Packet(id=7, is_resp=True, answers=[
+        P.Record("a.io.", P.A, ttl=60, rdata=parse_ip("1.2.3.4")),
+        P.Record("a.io.", P.AAAA, ttl=60, rdata=parse_ip("fe80::1")),
+        P.Record("a.io.", P.CNAME, ttl=60, rdata="b.io."),
+        P.Record("a.io.", P.SRV, ttl=60, rdata=(0, 10, 8080, "s1.a.io.")),
+        P.Record("a.io.", P.TXT, ttl=60, rdata=[b"hello", b"world"]),
+    ])
+    back = P.parse(resp.encode())
+    assert back.answers[0].rdata == parse_ip("1.2.3.4")
+    assert back.answers[1].rdata == parse_ip("fe80::1")
+    assert back.answers[2].rdata == "b.io."
+    assert back.answers[3].rdata == (0, 10, 8080, "s1.a.io.")
+    assert back.answers[4].rdata == [b"hello", b"world"]
+
+
+def test_codec_compression_pointers():
+    # handcraft a response with a compression pointer for the answer name
+    q = P._encode_name("svc.test.")
+    import struct
+    hdr = struct.pack(">HHHHHH", 1, 0x8180, 1, 1, 0, 0)
+    question = q + struct.pack(">HH", P.A, 1)
+    # answer name = pointer to offset 12 (the question name)
+    ans = b"\xc0\x0c" + struct.pack(">HHIH", P.A, 1, 30, 4) + bytes([9, 9, 9, 9])
+    pkt = P.parse(hdr + question + ans)
+    assert pkt.answers[0].name == "svc.test."
+    assert pkt.answers[0].rdata == bytes([9, 9, 9, 9])
+
+
+def dns_query(port, name, qtype=P.A, timeout=3):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(timeout)
+    pkt = P.Packet(id=99, rd=True, questions=[P.Question(name, qtype)])
+    s.sendto(pkt.encode(), ("127.0.0.1", port))
+    data, _ = s.recvfrom(4096)
+    s.close()
+    return P.parse(data)
+
+
+@pytest.fixture
+def dns_stack():
+    elg = EventLoopGroup("dns", 1)
+    resources = {"elg": elg, "servers": [], "groups": [], "dns": []}
+    yield resources
+    for d in resources["dns"]:
+        d.stop()
+    for g in resources["groups"]:
+        g.close()
+    for s in resources["servers"]:
+        s.close()
+    time.sleep(0.05)
+    elg.close()
+
+
+def test_dns_server_lb_answers(dns_stack):
+    elg = dns_stack["elg"]
+    s1, s2 = IdServer("A"), IdServer("B")
+    dns_stack["servers"] += [s1, s2]
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    dns_stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    g.add("b", "127.0.0.1", s2.port)
+    wait_healthy(g, 2)
+    rr = Upstream("rr")
+    rr.add(g, annotations=HintRule(host="svc.corp.local"))
+    d = DNSServer("dns0", elg.next(), "127.0.0.1", 0, rr,
+                  hosts={"pin.corp.local": parse_ip("10.9.9.9")})
+    dns_stack["dns"].append(d)
+    d.start()
+
+    # rrset hit -> A answer from a healthy backend
+    resp = dns_query(d.bind_port, "svc.corp.local.")
+    assert resp.is_resp and resp.rcode == 0
+    assert resp.answers[0].rtype == P.A
+    assert resp.answers[0].rdata == parse_ip("127.0.0.1")
+    # subdomain (suffix) also matches the hint rule
+    resp = dns_query(d.bind_port, "x.svc.corp.local.")
+    assert resp.answers and resp.answers[0].rdata == parse_ip("127.0.0.1")
+    # hosts-file entry wins
+    resp = dns_query(d.bind_port, "pin.corp.local.")
+    assert resp.answers[0].rdata == parse_ip("10.9.9.9")
+    # ip literal echo
+    resp = dns_query(d.bind_port, "4.3.2.1.")
+    assert resp.answers[0].rdata == parse_ip("4.3.2.1")
+    # SRV lists healthy servers with ports
+    resp = dns_query(d.bind_port, "svc.corp.local.", P.SRV)
+    ports = sorted(r.rdata[2] for r in resp.answers)
+    assert ports == sorted([s1.port, s2.port])
+    # unknown name without recursion -> NXDOMAIN
+    resp = dns_query(d.bind_port, "nope.example.")
+    assert resp.rcode == 3
+
+
+def test_dns_recursion_via_fake_upstream(dns_stack):
+    elg = dns_stack["elg"]
+    # fake upstream DNS: answers everything with 7.7.7.7
+    up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    up.bind(("127.0.0.1", 0))
+    up.settimeout(5)
+    up_port = up.getsockname()[1]
+    import threading
+
+    def serve():
+        try:
+            data, addr = up.recvfrom(4096)
+            req = P.parse(data)
+            resp = P.Packet(id=req.id, is_resp=True, questions=req.questions,
+                            answers=[P.Record(req.questions[0].qname, P.A,
+                                              ttl=5, rdata=parse_ip("7.7.7.7"))])
+            up.sendto(resp.encode(), addr)
+        except OSError:
+            pass
+    threading.Thread(target=serve, daemon=True).start()
+
+    rr = Upstream("rr")
+    loop = elg.next()
+    client = DNSClient(loop, [("127.0.0.1", up_port)], timeout_ms=1000)
+    d = DNSServer("dns1", loop, "127.0.0.1", 0, rr, recursive_client=client)
+    dns_stack["dns"].append(d)
+    d.start()
+    resp = dns_query(d.bind_port, "anything.example.com.")
+    assert resp.answers and resp.answers[0].rdata == parse_ip("7.7.7.7")
+    up.close()

@@ -1,0 +1,94 @@
+"""Socks5Server end-to-end using python's socket + manual SOCKS5 handshake."""
+import socket
+import struct
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.socks5 import Socks5Server
+from vproxy_tpu.components.servergroup import ServerGroup
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.rules.ir import HintRule
+
+from test_tcplb import IdServer, fast_hc, wait_healthy
+
+
+@pytest.fixture
+def s5(request):
+    elg = EventLoopGroup("s5", 1)
+    backend = IdServer("S5A")
+    g = ServerGroup("g", elg, fast_hc())
+    g.add("a", "127.0.0.1", backend.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g, annotations=HintRule(host="svc.example.com"))
+    srv = Socks5Server("s5", elg, elg, "127.0.0.1", 0, ups,
+                       allow_non_backend=getattr(request, "param", False))
+    srv.start()
+    yield srv, backend, elg
+    srv.stop()
+    g.close()
+    backend.close()
+    elg.close()
+
+
+def socks5_connect(port, atyp, addr, dport):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"\x05\x01\x00")
+    assert c.recv(2) == b"\x05\x00"
+    if atyp == 3:
+        req = b"\x05\x01\x00\x03" + bytes([len(addr)]) + addr.encode() + struct.pack(">H", dport)
+    elif atyp == 1:
+        req = b"\x05\x01\x00\x01" + socket.inet_aton(addr) + struct.pack(">H", dport)
+    c.sendall(req)
+    rep = c.recv(10)
+    return c, rep[1] if len(rep) > 1 else None
+
+
+def test_socks5_domain_to_backend(s5):
+    srv, backend, _ = s5
+    c, rep = socks5_connect(srv.bind_port, 3, "svc.example.com", 80)
+    assert rep == 0
+    assert c.recv(10) == b"S5A"  # IdServer sends its id on connect
+    c.sendall(b"ping")
+    assert c.recv(10) == b"ping"  # echo through the pump
+    c.close()
+
+
+def test_socks5_ip_matches_backend_list(s5):
+    srv, backend, _ = s5
+    c, rep = socks5_connect(srv.bind_port, 1, "127.0.0.1", backend.port)
+    assert rep == 0
+    assert c.recv(10) == b"S5A"
+    c.close()
+
+
+def test_socks5_unknown_target_rejected(s5):
+    srv, _, _ = s5
+    c, rep = socks5_connect(srv.bind_port, 3, "unknown.example.org", 443)
+    assert rep == 2  # not allowed by ruleset (allow_non_backend=False)
+    c.close()
+
+
+@pytest.mark.parametrize("s5", [True], indirect=True)
+def test_socks5_non_backend_direct(s5):
+    srv, _, _ = s5
+    other = IdServer("DIRECT")
+    try:
+        c, rep = socks5_connect(srv.bind_port, 1, "127.0.0.1", other.port)
+        assert rep == 0
+        assert c.recv(20) == b"DIRECT"
+        c.close()
+    finally:
+        other.close()
+
+
+def test_socks5_bad_auth_method(s5):
+    srv, _, _ = s5
+    c = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"\x05\x01\x02")  # only username/password offered
+    assert c.recv(2) == b"\x05\xff"
+    assert c.recv(10) == b""  # closed
+    c.close()

@@ -272,3 +272,31 @@ def test_bind_conflict_raises(stack):
     lb2 = TcpLB("lb2", elg, elg, "127.0.0.1", lb1.bind_port, ups)
     with pytest.raises(OSError):
         lb2.start()
+
+
+def test_idle_session_timeout(stack):
+    elg = stack["make_elg"](1)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup("g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g)
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               timeout_ms=1500)
+    stack["lbs"].append(lb)
+    lb.start()
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(10)
+    assert c.recv(10) == b"A"
+    # go idle: the sweep must kill the spliced session within ~2x timeout
+    t0 = time.time()
+    assert c.recv(100) == b""  # EOF when the pump is closed
+    assert time.time() - t0 < 6
+    c.close()
+    t0 = time.time()
+    while lb.active_sessions and time.time() - t0 < 5:
+        time.sleep(0.05)
+    assert lb.active_sessions == 0

@@ -1,14 +1,14 @@
 """EventLoopGroup — named set of worker loops with round-robin next().
 
 Analog of component/elgroup/EventLoopGroup.java (round-robin next()
-:188-207, attach/detach resource lifecycle). Worker topology follows
-app/Application.java:83-114: one control loop + N worker loops.
+:188-207, attach/detach resource lifecycle, named event-loop add/remove).
+Worker topology follows app/Application.java:83-114: one control loop +
+N worker loops.
 """
 from __future__ import annotations
 
 import itertools
-import threading
-from typing import Callable, Optional
+from typing import Optional
 
 from ..net.eventloop import SelectorEventLoop
 
@@ -16,19 +16,42 @@ from ..net.eventloop import SelectorEventLoop
 class EventLoopGroup:
     def __init__(self, name: str, n_loops: int = 1):
         self.name = name
-        self.loops: list[SelectorEventLoop] = []
+        self._loops: dict[str, SelectorEventLoop] = {}
         self._rr = itertools.count()
         self._closed = False
         self._resources: list = []
         for i in range(n_loops):
-            lp = SelectorEventLoop(f"{name}-{i}")
-            lp.loop_thread()
-            self.loops.append(lp)
+            self.add_loop(f"{name}-{i}")
+
+    @property
+    def loops(self) -> list[SelectorEventLoop]:
+        return list(self._loops.values())
+
+    def loop_names(self) -> list[str]:
+        return list(self._loops.keys())
+
+    def add_loop(self, name: str) -> SelectorEventLoop:
+        if name in self._loops:
+            raise ValueError(f"event-loop {name} already exists in {self.name}")
+        lp = SelectorEventLoop(name)
+        lp.loop_thread()
+        self._loops[name] = lp
+        return lp
+
+    def remove_loop(self, name: str) -> None:
+        lp = self._loops.pop(name, None)
+        if lp is None:
+            raise KeyError(name)
+        lp.close()
+
+    def get_loop(self, name: str) -> Optional[SelectorEventLoop]:
+        return self._loops.get(name)
 
     def next(self) -> SelectorEventLoop:
-        if not self.loops:
+        loops = self.loops
+        if not loops:
             raise RuntimeError(f"event loop group {self.name} is empty")
-        return self.loops[next(self._rr) % len(self.loops)]
+        return loops[next(self._rr) % len(loops)]
 
     def attach(self, resource) -> None:
         self._resources.append(resource)
@@ -47,3 +70,4 @@ class EventLoopGroup:
                 closer()
         for lp in self.loops:
             lp.close()
+        self._loops.clear()

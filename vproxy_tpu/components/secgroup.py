@@ -23,8 +23,10 @@ class SecurityGroup:
         self.default_allow = default_allow
         self._rules: list[AclRule] = []
         self._backend = backend
-        self._matchers: dict[Proto, CidrMatcher] = {}
-        self._subs: dict[Proto, list[AclRule]] = {}  # snapshot per recalc
+        # proto -> (matcher, rules) published atomically; matchers are
+        # immutable once published (a recalc builds a NEW one) so a data-
+        # plane allow() never sees a half-updated table/rule-list pair
+        self._tables: dict[Proto, tuple[CidrMatcher, list[AclRule]]] = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -58,32 +60,25 @@ class SecurityGroup:
     def _recalc(self, proto: Proto) -> None:
         sub = [r for r in self._rules if r.protocol == proto]
         if not sub:
-            self._matchers.pop(proto, None)
-            self._subs.pop(proto, None)
+            self._tables.pop(proto, None)
             return
-        m = self._matchers.get(proto)
-        if m is None:
-            m = CidrMatcher([r.network for r in sub], backend=self._backend,
-                            acl=sub)
-        else:
-            m.set_networks([r.network for r in sub], acl=sub)
-        # publish matcher + the exact rule list it was compiled from together
-        self._subs[proto] = sub
-        self._matchers[proto] = m
+        m = CidrMatcher([r.network for r in sub], backend=self._backend,
+                        acl=sub)
+        self._tables[proto] = (m, sub)  # atomic publish
 
     def allow(self, proto: Proto, addr: bytes, port: int) -> bool:
-        m = self._matchers.get(proto)
-        if m is None:
+        ent = self._tables.get(proto)
+        if ent is None:
             return self.default_allow
-        sub = self._subs[proto]
+        m, sub = ent
         idx = m.match_one(addr, port)
         return sub[idx].allow if idx >= 0 else self.default_allow
 
     def allow_batch(self, proto: Proto, addrs: Sequence[bytes],
                     ports: Sequence[int]) -> list[bool]:
-        m = self._matchers.get(proto)
-        if m is None:
+        ent = self._tables.get(proto)
+        if ent is None:
             return [self.default_allow] * len(addrs)
-        sub = self._subs[proto]
+        m, sub = ent
         return [sub[i].allow if i >= 0 else self.default_allow
                 for i in m.match(addrs, ports)]

@@ -1,0 +1,218 @@
+"""Socks5Server — SOCKS5 proxy with upstream-backed target selection.
+
+Parity: component/app/Socks5Server.java — domain CONNECTs become
+Hint.ofHostPort lookups into the upstream (:63-66); IP CONNECTs are
+matched against the backend server list (:73-82); unmatched targets are
+only honored when allow_non_backend is set (direct connect). Handshake
+is the RFC 1928 no-auth flow (socks/Socks5ProxyProtocolHandler.java).
+After the reply, the session drops into the native splice pump.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..net import vtl
+from ..net.connection import Connection, Handler, ServerSock
+from ..rules.ir import Hint, Proto
+from ..utils.ip import format_ip, parse_ip
+from .elgroup import EventLoopGroup
+from .secgroup import SecurityGroup
+from .servergroup import Connector
+from .tcplb import TcpLB
+from .upstream import Upstream
+
+VER = 5
+CMD_CONNECT = 1
+ATYP_V4, ATYP_DOMAIN, ATYP_V6 = 1, 3, 4
+REP_OK, REP_FAIL, REP_NOT_ALLOWED, REP_HOST_UNREACH, REP_CMD_UNSUP = 0, 1, 2, 4, 7
+
+
+class Socks5Server(TcpLB):
+    """Same resource shape as TcpLB (bind, elgroups, upstream, secgroup)
+    with the SOCKS5 handshake instead of http/tcp classify."""
+
+    def __init__(self, alias: str, acceptor: EventLoopGroup,
+                 worker: EventLoopGroup, bind_ip: str, bind_port: int,
+                 backend: Upstream,
+                 security_group: Optional[SecurityGroup] = None,
+                 allow_non_backend: bool = False,
+                 in_buffer_size: int = 65536):
+        super().__init__(alias, acceptor, worker, bind_ip, bind_port, backend,
+                         protocol="tcp", security_group=security_group,
+                         in_buffer_size=in_buffer_size)
+        self.allow_non_backend = allow_non_backend
+
+    # override: every accepted conn goes through the handshake
+    def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
+        _Socks5Session(self, loop, cfd, ip, port)
+
+    # ---------------------------------------------------------- selection
+
+    def pick_target(self, client_ip: bytes, atyp: int, addr, port: int
+                    ) -> tuple[Optional[Connector], Optional[tuple[str, int]]]:
+        """-> (connector, direct_addr). Only one is non-None on success."""
+        if atyp == ATYP_DOMAIN:
+            c = self.backend.seek(client_ip, Hint.of_host_port(addr, port))
+            if c is not None:
+                return c, None
+            if self.allow_non_backend:
+                return None, (addr, port)
+            return None, None
+        ip_str = format_ip(addr)
+        # match the literal ip:port against known backend servers
+        for h in self.backend.handles:
+            for s in h.group.servers:
+                if s.port == port and s.ip == ip_str and s.healthy:
+                    return Connector(s, h.group), None
+        if self.allow_non_backend:
+            return None, (ip_str, port)
+        return None, None
+
+
+class _Socks5Session(Handler):
+    ST_GREETING, ST_REQUEST, ST_DONE = range(3)
+
+    def __init__(self, server: Socks5Server, loop, cfd: int, ip: str, port: int):
+        self.server = server
+        self.loop = loop
+        self.client_ip = ip
+        self.buf = bytearray()
+        self.state = self.ST_GREETING
+        self.conn = Connection(loop, cfd, (ip, port))
+        self.conn.set_handler(self)
+
+    def on_data(self, conn: Connection, data: bytes) -> None:
+        self.buf += data
+        if self.state == self.ST_GREETING:
+            self._try_greeting(conn)
+        if self.state == self.ST_REQUEST:
+            self._try_request(conn)
+
+    def _try_greeting(self, conn: Connection) -> None:
+        if len(self.buf) < 2:
+            return
+        ver, n = self.buf[0], self.buf[1]
+        if ver != VER:
+            conn.close()
+            return
+        if len(self.buf) < 2 + n:
+            return
+        methods = self.buf[2: 2 + n]
+        del self.buf[: 2 + n]
+        if 0 not in methods:  # only no-auth supported
+            conn.write(b"\x05\xff")
+            self.loop.delay(20, conn.close)
+            self.state = self.ST_DONE
+            return
+        conn.write(b"\x05\x00")
+        self.state = self.ST_REQUEST
+
+    def _try_request(self, conn: Connection) -> None:
+        if len(self.buf) < 4:
+            return
+        ver, cmd, _rsv, atyp = self.buf[:4]
+        if ver != VER:
+            conn.close()
+            return
+        if atyp == ATYP_V4:
+            need = 4 + 4 + 2
+        elif atyp == ATYP_V6:
+            need = 4 + 16 + 2
+        elif atyp == ATYP_DOMAIN:
+            if len(self.buf) < 5:
+                return
+            need = 4 + 1 + self.buf[4] + 2
+        else:
+            self._reply(conn, REP_FAIL)
+            return
+        if len(self.buf) < need:
+            return
+        if cmd != CMD_CONNECT:
+            self._reply(conn, REP_CMD_UNSUP)
+            return
+        if atyp == ATYP_DOMAIN:
+            dlen = self.buf[4]
+            addr = bytes(self.buf[5:5 + dlen]).decode("latin-1")
+            port = struct.unpack(">H", self.buf[5 + dlen:7 + dlen])[0]
+        else:
+            alen = 4 if atyp == ATYP_V4 else 16
+            addr = bytes(self.buf[4:4 + alen])
+            port = struct.unpack(">H", self.buf[4 + alen:6 + alen])[0]
+        del self.buf[:need]
+        self.state = self.ST_DONE
+
+        connector, direct = self.server.pick_target(
+            parse_ip(self.client_ip), atyp, addr, port)
+        if connector is None and direct is None:
+            self._reply(conn, REP_NOT_ALLOWED)
+            return
+        target = (connector.ip, connector.port) if connector else direct
+        self._connect_and_splice(conn, connector, target)
+
+    def _reply(self, conn: Connection, rep: int) -> None:
+        conn.write(b"\x05" + bytes([rep]) + b"\x00\x01\x00\x00\x00\x00\x00\x00")
+        if rep != REP_OK:
+            self.loop.delay(20, conn.close)
+
+    def _connect_and_splice(self, conn: Connection, connector, target) -> None:
+        lb = self.server
+        session = self
+        svr = connector.svr if connector else None
+        if svr is not None:
+            svr.conn_count += 1
+        lb.active_sessions += 1
+
+        def release() -> None:
+            if svr is not None:
+                svr.conn_count -= 1
+            lb.active_sessions -= 1
+
+        try:
+            back = Connection.connect(self.loop, target[0], target[1])
+        except OSError:
+            release()
+            self._reply(conn, REP_HOST_UNREACH)
+            return
+
+        class Back(Handler):
+            def on_connected(self, bconn: Connection) -> None:
+                # keep early backend bytes in the kernel buffer for the pump
+                bconn.pause_reading()
+                session._reply(conn, REP_OK)
+                leftover = bytes(session.buf)
+                if leftover:
+                    bconn.write(leftover)
+                if bconn.out:
+                    return
+                self._handover(bconn)
+
+            def on_drained(self, bconn: Connection) -> None:
+                self._handover(bconn)
+
+            def _handover(self, bconn: Connection) -> None:
+                if bconn.detached or bconn.closed or conn.closed:
+                    return
+                ffd = conn.detach()
+                bfd = bconn.detach()
+                vtl.set_nodelay(ffd)
+                vtl.set_nodelay(bfd)
+                session.loop.pump(ffd, bfd, lb.in_buffer_size, self._done)
+
+            def _done(self, a2b: int, b2a: int, err: int) -> None:
+                lb.bytes_in += a2b
+                lb.bytes_out += b2a
+                if svr is not None:
+                    svr.bytes_in += a2b
+                    svr.bytes_out += b2a
+                release()
+
+            def on_closed(self, bconn: Connection, err: int) -> None:
+                release()
+                if not conn.closed and not conn.detached:
+                    session._reply(conn, REP_HOST_UNREACH)
+
+        back.set_handler(Back())
+
+    def on_eof(self, conn: Connection) -> None:
+        conn.close()

@@ -14,6 +14,7 @@ before splicing.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ..net import vtl
@@ -52,6 +53,8 @@ class TcpLB:
         self.active_sessions = 0
         self.bytes_in = 0
         self.bytes_out = 0
+        self._pump_watch: dict[int, dict] = {}  # id(loop) -> {pid: (total, ts)}
+        self._sweep_armed: set[int] = set()
 
     # ------------------------------------------------------------ control
 
@@ -59,32 +62,37 @@ class TcpLB:
         if self.started:
             return
         self.started = True
-        done = []
-        errors = []
-        for lp in self.acceptor.loops:
-            def mk(lp=lp):
+        errors: list[OSError] = []
+        loops = self.acceptor.loops
+        # bind the first loop alone so an ephemeral port (bind_port=0) is
+        # resolved once and the remaining loops share it via REUSEPORT
+        for lp in loops:
+            ev = threading.Event()
+
+            def mk(lp=lp) -> None:
                 try:
-                    self.server_socks.append(ServerSock(
+                    ss = ServerSock(
                         lp, self.bind_ip, self.bind_port,
                         lambda fd, ip, port, lp=lp: self._on_accept(lp, fd, ip, port),
-                        reuseport=len(self.acceptor.loops) > 1))
+                        reuseport=len(loops) > 1)
+                    self.server_socks.append(ss)
+                    if self.bind_port == 0:
+                        self.bind_port = ss.port
                 except OSError as e:
                     errors.append(e)
                 finally:
-                    done.append(1)
+                    ev.set()
             lp.run_on_loop(mk)
-        import time
-        t0 = time.time()
-        while len(done) < len(self.acceptor.loops) and time.time() - t0 < 5:
-            time.sleep(0.002)
-        if errors or len(self.server_socks) < len(self.acceptor.loops):
+            if not ev.wait(5):
+                errors.append(OSError("bind timeout"))
+            if errors:
+                break
+        if errors or len(self.server_socks) < len(loops):
             self.stop()
             self.started = False
             raise OSError(
                 f"tcp-lb {self.alias}: bind failed on "
                 f"{self.bind_ip}:{self.bind_port}: {errors[:1] or 'timeout'}")
-        if self.bind_port == 0:
-            self.bind_port = self.server_socks[0].port
 
     def stop(self) -> None:
         if not self.started:
@@ -118,10 +126,56 @@ class TcpLB:
         else:
             self._http_classify(loop, cfd, ip, port)
 
+    # ------------------------------------------------------ idle timeout
+
+    def _watch_pump(self, loop, pid: int) -> None:
+        """Track spliced-session activity; kill sessions idle > timeout_ms
+        (the reference's tcpTimeout, Config.java:20 — default 15 min)."""
+        st = self._pump_watch.setdefault(id(loop), {})
+        st[pid] = (0, loop.now)
+        if len(st) == 1:
+            self._arm_sweep(loop)
+
+    def _unwatch_pump(self, loop, pid) -> None:
+        self._pump_watch.get(id(loop), {}).pop(pid, None)
+
+    def _arm_sweep(self, loop) -> None:
+        interval = max(self.timeout_ms // 4, 1000)
+
+        def sweep() -> None:
+            st = self._pump_watch.get(id(loop), {})
+            if not st or not self.started:
+                return
+            for pid, (last_total, last_ts) in list(st.items()):
+                try:
+                    a2b, b2a, _err = loop.pump_stat(pid)
+                except OSError:
+                    st.pop(pid, None)
+                    continue
+                total = a2b + b2a
+                if total != last_total:
+                    st[pid] = (total, loop.now)
+                elif (loop.now - last_ts) * 1000 >= self.timeout_ms:
+                    st.pop(pid, None)
+                    loop.pump_close(pid)
+            if st:
+                loop.delay(interval, sweep)
+            else:
+                self._sweep_armed.discard(id(loop))
+
+        if id(loop) not in self._sweep_armed:
+            self._sweep_armed.add(id(loop))
+            loop.delay(interval, sweep)
+
     def _http_classify(self, loop, cfd: int, ip: str, port: int) -> None:
         lb = self
         parser = HeadParser()
         front = Connection(loop, cfd, (ip, port))
+        # a client that never completes its head is dropped at the timeout
+        def head_timeout() -> None:
+            if not front.closed and not front.detached:
+                front.close()
+        loop.delay(lb.timeout_ms, head_timeout)
 
         class Front(Handler):
             def on_data(self, conn: Connection, data: bytes) -> None:
@@ -163,6 +217,9 @@ class TcpLB:
 
         class Back(Handler):
             def on_connected(self, conn: Connection) -> None:
+                # do NOT consume early backend bytes (100-continue, early
+                # errors): leave them queued in the kernel for the pump
+                conn.pause_reading()
                 if head:
                     conn.write(head)
                 if conn.out:
@@ -179,9 +236,12 @@ class TcpLB:
                 bfd = conn.detach()
                 vtl.set_nodelay(front_fd)
                 vtl.set_nodelay(bfd)
-                loop.pump(front_fd, bfd, lb.in_buffer_size, self._done)
+                pid = loop.pump(front_fd, bfd, lb.in_buffer_size, self._done)
+                self._pid = pid
+                lb._watch_pump(loop, pid)
 
             def _done(self, a2b: int, b2a: int, err: int) -> None:
+                lb._unwatch_pump(loop, getattr(self, "_pid", None))
                 lb.bytes_in += a2b
                 lb.bytes_out += b2a
                 svr.bytes_in += a2b

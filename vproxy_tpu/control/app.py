@@ -1,0 +1,85 @@
+"""Application — the singleton resource-holder registry.
+
+Analog of app/Application.java:16-115: one holder per resource kind plus
+the default event-loop topology (a control loop, N worker loops, the
+acceptor group aliased to the worker group — REUSEPORT always available
+on the Linux hosts we target).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..components.elgroup import EventLoopGroup
+from ..components.secgroup import SecurityGroup
+from ..components.servergroup import ServerGroup
+from ..components.socks5 import Socks5Server
+from ..components.tcplb import TcpLB
+from ..components.upstream import Upstream
+from ..dns.server import DNSServer
+
+DEFAULT_ACCEPTOR_ELG = "(acceptor-elg)"
+DEFAULT_WORKER_ELG = "(worker-elg)"
+DEFAULT_CONTROL_ELG = "(control-elg)"
+
+
+class Application:
+    _instance: Optional["Application"] = None
+
+    def __init__(self, workers: Optional[int] = None):
+        if workers is None:
+            workers = int(os.environ.get("VPROXY_TPU_WORKERS", "0")) or (
+                os.cpu_count() or 1)
+        self.elgs: dict[str, EventLoopGroup] = {}
+        self.upstreams: dict[str, Upstream] = {}
+        self.server_groups: dict[str, ServerGroup] = {}
+        self.security_groups: dict[str, SecurityGroup] = {}
+        self.tcp_lbs: dict[str, TcpLB] = {}
+        self.socks5_servers: dict[str, Socks5Server] = {}
+        self.dns_servers: dict[str, DNSServer] = {}
+        self.cert_keys: dict[str, object] = {}
+        self.switches: dict[str, object] = {}
+
+        self.elgs[DEFAULT_CONTROL_ELG] = EventLoopGroup(DEFAULT_CONTROL_ELG, 1)
+        worker = EventLoopGroup(DEFAULT_WORKER_ELG, workers)
+        self.elgs[DEFAULT_WORKER_ELG] = worker
+        # acceptor aliased to worker (Application.java:103-105, REUSEPORT)
+        self.elgs[DEFAULT_ACCEPTOR_ELG] = worker
+
+    @property
+    def control_loop(self):
+        return self.elgs[DEFAULT_CONTROL_ELG].loops[0]
+
+    @property
+    def worker_elg(self) -> EventLoopGroup:
+        return self.elgs[DEFAULT_WORKER_ELG]
+
+    @property
+    def acceptor_elg(self) -> EventLoopGroup:
+        return self.elgs[DEFAULT_ACCEPTOR_ELG]
+
+    @classmethod
+    def create(cls, workers: Optional[int] = None) -> "Application":
+        cls._instance = cls(workers)
+        return cls._instance
+
+    @classmethod
+    def get(cls) -> "Application":
+        if cls._instance is None:
+            raise RuntimeError("Application not created")
+        return cls._instance
+
+    def close(self) -> None:
+        for lb in list(self.tcp_lbs.values()) + list(self.socks5_servers.values()):
+            lb.stop()
+        for d in self.dns_servers.values():
+            d.stop()
+        for g in self.server_groups.values():
+            g.close()
+        seen = set()
+        for elg in self.elgs.values():
+            if id(elg) not in seen:
+                seen.add(id(elg))
+                elg.close()
+        if Application._instance is self:
+            Application._instance = None

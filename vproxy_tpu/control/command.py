@@ -1,0 +1,605 @@
+"""Command engine — the operator-facing grammar.
+
+Parity: app cmd/Command.java (parse+validate+dispatch, 8 actions) and
+cmd/handle/resource/* handlers, with the same vocabulary as
+doc/command.md:
+
+    $action $type [$alias] [in $type $alias] [to|from $type $alias]
+            [$param-key $param-value]... [$flag]...
+
+Actions: add(a), list(l), list-detail(L), update(u), remove(r),
+force-remove(R); `add ... to ...` attaches, `remove ... from ...`
+detaches. All controllers (stdio / RESP / HTTP) funnel into
+Command.execute on the control loop, mirroring the reference's
+control-plane isolation (doc/architecture.md:64-66).
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..components.secgroup import SecurityGroup
+from ..components.servergroup import HealthCheckConfig, ServerGroup
+from ..components.socks5 import Socks5Server
+from ..components.tcplb import TcpLB
+from ..components.upstream import Upstream
+from ..components.elgroup import EventLoopGroup
+from ..dns.server import DNSServer
+from ..rules.ir import AclRule, HintRule, Proto
+from ..utils.ip import Network
+from .app import (Application, DEFAULT_ACCEPTOR_ELG, DEFAULT_WORKER_ELG)
+
+ACTIONS = {"add": "add", "a": "add", "list": "list", "l": "list",
+           "list-detail": "list-detail", "L": "list-detail",
+           "update": "update", "u": "update", "remove": "remove",
+           "r": "remove", "force-remove": "force-remove", "R": "force-remove"}
+
+TYPES = {
+    "tcp-lb": "tcp-lb", "tl": "tcp-lb",
+    "socks5-server": "socks5-server", "socks5": "socks5-server",
+    "dns-server": "dns-server", "dns": "dns-server",
+    "event-loop-group": "event-loop-group", "elg": "event-loop-group",
+    "event-loop": "event-loop", "el": "event-loop",
+    "upstream": "upstream", "ups": "upstream",
+    "server-group": "server-group", "sg": "server-group",
+    "server": "server", "svr": "server",
+    "security-group": "security-group", "secg": "security-group",
+    "security-group-rule": "security-group-rule", "secgr": "security-group-rule",
+    "cert-key": "cert-key", "ck": "cert-key",
+    "switch": "switch", "sw": "switch",
+    "server-sock": "server-sock", "ss": "server-sock",
+    "connection": "connection", "conn": "connection",
+    "session": "session", "sess": "session",
+    "bytes-in": "bytes-in", "bin": "bytes-in",
+    "bytes-out": "bytes-out", "bout": "bytes-out",
+    "accepted-conn-count": "accepted-conn-count",
+    "dns-cache": "dns-cache",
+}
+
+PARAM_KEYS = {
+    "address": "address", "addr": "address",
+    "upstream": "upstream", "ups": "upstream",
+    "event-loop-group": "elg", "elg": "elg",
+    "acceptor-elg": "aelg", "aelg": "aelg",
+    "in-buffer-size": "in-buffer-size", "out-buffer-size": "out-buffer-size",
+    "protocol": "protocol",
+    "security-group": "secg", "secg": "secg",
+    "cert-key": "ck", "ck": "ck",
+    "ttl": "ttl", "timeout": "timeout", "period": "period",
+    "up": "up", "down": "down", "method": "method",
+    "weight": "weight", "w": "weight",
+    "annotations": "annotations", "default": "default",
+    "network": "network", "net": "network",
+    "port-range": "port-range",
+}
+
+FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
+
+ANNO_HOST = "vproxy/hint-host"
+ANNO_PORT = "vproxy/hint-port"
+ANNO_URI = "vproxy/hint-uri"
+
+
+class CmdError(Exception):
+    pass
+
+
+class Command:
+    def __init__(self):
+        self.action = ""
+        self.type = ""
+        self.alias: Optional[str] = None
+        self.contexts: list[tuple[str, str]] = []  # `in` chain, innermost first
+        self.target: Optional[tuple[str, str]] = None  # to/from
+        self.params: dict[str, str] = {}
+        self.flags: set[str] = set()
+
+    # ------------------------------------------------------------ parsing
+
+    @staticmethod
+    def parse(line: str) -> "Command":
+        toks = line.split()
+        if not toks:
+            raise CmdError("empty command")
+        c = Command()
+        if toks[0] not in ACTIONS:
+            raise CmdError(f"unknown action {toks[0]!r}")
+        c.action = ACTIONS[toks[0]]
+        if len(toks) < 2 or toks[1] not in TYPES:
+            raise CmdError(f"unknown resource type {toks[1] if len(toks) > 1 else ''!r}")
+        c.type = TYPES[toks[1]]
+        i = 2
+        if c.action not in ("list", "list-detail"):
+            if i >= len(toks):
+                raise CmdError("resource alias required")
+            c.alias = toks[i]
+            i += 1
+        while i < len(toks):
+            t = toks[i]
+            if t == "in":
+                if i + 2 >= len(toks) - 0 and i + 2 > len(toks) - 1:
+                    raise CmdError("`in` requires type and alias")
+                if toks[i + 1] not in TYPES:
+                    raise CmdError(f"unknown resource type {toks[i+1]!r}")
+                c.contexts.append((TYPES[toks[i + 1]], toks[i + 2]))
+                i += 3
+            elif t in ("to", "from"):
+                if i + 2 > len(toks) - 1:
+                    raise CmdError(f"`{t}` requires type and alias")
+                if toks[i + 1] not in TYPES:
+                    raise CmdError(f"unknown resource type {toks[i+1]!r}")
+                c.target = (TYPES[toks[i + 1]], toks[i + 2])
+                i += 3
+            elif t in PARAM_KEYS:
+                if i + 1 > len(toks) - 1:
+                    raise CmdError(f"param {t} requires a value")
+                key = PARAM_KEYS[t]
+                val = toks[i + 1]
+                # annotations value is json and may contain spaces: re-join
+                if key == "annotations" and val.startswith("{") and not val.endswith("}"):
+                    j = i + 2
+                    while j < len(toks) and not toks[j - 1].endswith("}"):
+                        val += " " + toks[j]
+                        j += 1
+                    i = j - 2
+                c.params[key] = val
+                i += 2
+            elif t in FLAGS:
+                c.flags.add(t)
+                i += 1
+            else:
+                raise CmdError(f"unexpected token {t!r}")
+        return c
+
+    # ---------------------------------------------------------- execution
+
+    @staticmethod
+    def execute(app: Application, line: str):
+        c = Command.parse(line)
+        handler = _HANDLERS.get(c.type)
+        if handler is None:
+            raise CmdError(f"no handler for resource type {c.type}")
+        return handler(app, c)
+
+
+# ---------------------------------------------------------------- helpers
+
+def _need(app_dict: dict, alias: str, kind: str):
+    if alias not in app_dict:
+        raise CmdError(f"{kind} {alias!r} not found")
+    return app_dict[alias]
+
+
+def _addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    try:
+        return host, int(port)
+    except ValueError:
+        raise CmdError(f"invalid address {s!r}")
+
+
+def _anno_to_rule(anno_json: str) -> HintRule:
+    try:
+        d = json.loads(anno_json)
+    except json.JSONDecodeError as e:
+        raise CmdError(f"annotations must be json: {e}")
+    return HintRule(host=d.get(ANNO_HOST), port=int(d.get(ANNO_PORT, 0)),
+                    uri=d.get(ANNO_URI))
+
+
+def _rule_to_anno(rule: HintRule) -> str:
+    d = {}
+    if rule.host is not None:
+        d[ANNO_HOST] = rule.host
+    if rule.port:
+        d[ANNO_PORT] = str(rule.port)
+    if rule.uri is not None:
+        d[ANNO_URI] = rule.uri
+    return json.dumps(d, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------- handlers
+
+def _h_elg(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.elgs:
+            raise CmdError(f"event-loop-group {c.alias} already exists")
+        app.elgs[c.alias] = EventLoopGroup(c.alias, 0)
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        return list(app.elgs.keys())
+    if c.action in ("remove", "force-remove"):
+        elg = _need(app.elgs, c.alias, "event-loop-group")
+        if c.alias in (DEFAULT_WORKER_ELG, DEFAULT_ACCEPTOR_ELG, "(control-elg)"):
+            raise CmdError(f"cannot remove built-in {c.alias}")
+        elg.close()
+        del app.elgs[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for event-loop-group")
+
+
+def _h_el(app: Application, c: Command):
+    ctx = c.target or (c.contexts[0] if c.contexts else None)
+    if ctx is None or ctx[0] != "event-loop-group":
+        raise CmdError("event-loop requires `in/to event-loop-group <name>`")
+    elg = _need(app.elgs, ctx[1], "event-loop-group")
+    if c.action == "add":
+        elg.add_loop(c.alias)
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        return elg.loop_names()
+    if c.action in ("remove", "force-remove"):
+        try:
+            elg.remove_loop(c.alias)
+        except KeyError:
+            raise CmdError(f"event-loop {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for event-loop")
+
+
+def _h_ups(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.upstreams:
+            raise CmdError(f"upstream {c.alias} already exists")
+        app.upstreams[c.alias] = Upstream(c.alias)
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        return list(app.upstreams.keys())
+    if c.action in ("remove", "force-remove"):
+        ups = _need(app.upstreams, c.alias, "upstream")
+        if c.action == "remove":
+            users = [lb.alias for lb in list(app.tcp_lbs.values())
+                     + list(app.socks5_servers.values()) if lb.backend is ups]
+            users += [d.alias for d in app.dns_servers.values() if d.rrsets is ups]
+            if users:
+                raise CmdError(f"upstream {c.alias} is in use by {users}")
+        del app.upstreams[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for upstream")
+
+
+def _h_sg(app: Application, c: Command):
+    if c.action == "add" and c.target is not None:
+        # attach: add server-group sg0 to upstream ups0 weight 10
+        if c.target[0] != "upstream":
+            raise CmdError("server-group can only be attached to upstream")
+        sg = _need(app.server_groups, c.alias, "server-group")
+        ups = _need(app.upstreams, c.target[1], "upstream")
+        weight = int(c.params.get("weight", 10))
+        anno = _anno_to_rule(c.params["annotations"]) if "annotations" in c.params else None
+        ups.add(sg, weight, anno)
+        return "OK"
+    if c.action == "add":
+        if c.alias in app.server_groups:
+            raise CmdError(f"server-group {c.alias} already exists")
+        hc = HealthCheckConfig(
+            timeout_ms=int(c.params.get("timeout", 2000)),
+            period_ms=int(c.params.get("period", 5000)),
+            up=int(c.params.get("up", 2)),
+            down=int(c.params.get("down", 3)),
+            protocol=c.params.get("protocol", "tcp"))
+        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
+        anno = _anno_to_rule(c.params["annotations"]) if "annotations" in c.params else None
+        app.server_groups[c.alias] = ServerGroup(
+            c.alias, elg, hc, c.params.get("method", "wrr"), anno)
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.contexts and c.contexts[0][0] == "upstream":
+            ups = _need(app.upstreams, c.contexts[0][1], "upstream")
+            if c.action == "list":
+                return [h.alias for h in ups.handles]
+            return [f"{h.alias} -> weight {h.weight} annotations {_rule_to_anno(h.merged_rule())}"
+                    for h in ups.handles]
+        if c.action == "list":
+            return list(app.server_groups.keys())
+        out = []
+        for g in app.server_groups.values():
+            out.append(f"{g.alias} -> timeout {g.hc.timeout_ms} period {g.hc.period_ms} "
+                       f"up {g.hc.up} down {g.hc.down} protocol {g.hc.protocol} "
+                       f"method {g.method} event-loop-group {g.elg.name} "
+                       f"annotations {_rule_to_anno(g.annotations)}")
+        return out
+    if c.action == "update":
+        sg = _need(app.server_groups, c.alias, "server-group")
+        if c.contexts and c.contexts[0][0] == "upstream":
+            ups = _need(app.upstreams, c.contexts[0][1], "upstream")
+            for h in ups.handles:
+                if h.group is sg:
+                    if "weight" in c.params:
+                        h.weight = int(c.params["weight"])
+                    if "annotations" in c.params:
+                        h.annotations = _anno_to_rule(c.params["annotations"])
+                    ups._recalc()
+                    return "OK"
+            raise CmdError(f"server-group {c.alias} not attached to {c.contexts[0][1]}")
+        if any(k in c.params for k in ("timeout", "period", "up", "down", "protocol")):
+            sg.hc = HealthCheckConfig(
+                timeout_ms=int(c.params.get("timeout", sg.hc.timeout_ms)),
+                period_ms=int(c.params.get("period", sg.hc.period_ms)),
+                up=int(c.params.get("up", sg.hc.up)),
+                down=int(c.params.get("down", sg.hc.down)),
+                protocol=c.params.get("protocol", "tcp"))
+        if "method" in c.params:
+            if c.params["method"] not in ServerGroup.METHODS:
+                raise CmdError(f"unknown method {c.params['method']}")
+            sg.method = c.params["method"]
+        if "annotations" in c.params:
+            sg.annotations = _anno_to_rule(c.params["annotations"])
+            for ups in app.upstreams.values():
+                if any(h.group is sg for h in ups.handles):
+                    ups._recalc()
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        sg = _need(app.server_groups, c.alias, "server-group")
+        if c.target is not None:  # remove ... from upstream
+            if c.target[0] != "upstream":
+                raise CmdError("server-group can only be detached from upstream")
+            ups = _need(app.upstreams, c.target[1], "upstream")
+            ups.remove(sg)
+            return "OK"
+        users = [u.alias for u in app.upstreams.values()
+                 if any(h.group is sg for h in u.handles)]
+        if users and c.action == "remove":
+            raise CmdError(f"server-group {c.alias} is in use by upstream {users}")
+        for u in app.upstreams.values():
+            if any(h.group is sg for h in u.handles):
+                u.remove(sg)
+        sg.close()
+        del app.server_groups[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for server-group")
+
+
+def _h_svr(app: Application, c: Command):
+    ctx = c.target or (c.contexts[0] if c.contexts else None)
+    if ctx is None or ctx[0] != "server-group":
+        raise CmdError("server requires `in/to server-group <name>`")
+    sg = _need(app.server_groups, ctx[1], "server-group")
+    if c.action == "add":
+        ip, port = _addr(c.params["address"])
+        sg.add(c.alias, ip, port, int(c.params.get("weight", 10)))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return [s.name for s in sg.servers]
+        return [f"{s.name} -> connect-to {s.ip}:{s.port} weight {s.weight} "
+                f"currently {'UP' if s.healthy else 'DOWN'}"
+                for s in sg.servers]
+    if c.action == "update":
+        sg.set_weight(c.alias, int(c.params["weight"]))
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        try:
+            sg.remove(c.alias)
+        except KeyError:
+            raise CmdError(f"server {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for server")
+
+
+def _h_secg(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.security_groups:
+            raise CmdError(f"security-group {c.alias} already exists")
+        default = c.params.get("default", "allow")
+        if default not in ("allow", "deny"):
+            raise CmdError("default must be allow or deny")
+        app.security_groups[c.alias] = SecurityGroup(c.alias, default == "allow")
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.security_groups.keys())
+        return [f"{g.alias} -> default {'allow' if g.default_allow else 'deny'}"
+                for g in app.security_groups.values()]
+    if c.action == "update":
+        g = _need(app.security_groups, c.alias, "security-group")
+        if "default" in c.params:
+            g.default_allow = c.params["default"] == "allow"
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        g = _need(app.security_groups, c.alias, "security-group")
+        users = [lb.alias for lb in list(app.tcp_lbs.values())
+                 + list(app.socks5_servers.values()) if lb.security_group is g]
+        if users and c.action == "remove":
+            raise CmdError(f"security-group {c.alias} is in use by {users}")
+        del app.security_groups[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for security-group")
+
+
+def _h_secgr(app: Application, c: Command):
+    ctx = c.target or (c.contexts[0] if c.contexts else None)
+    if ctx is None or ctx[0] != "security-group":
+        raise CmdError("security-group-rule requires `in/to security-group <name>`")
+    g = _need(app.security_groups, ctx[1], "security-group")
+    if c.action == "add":
+        net = Network.parse(c.params["network"])
+        proto = Proto(c.params.get("protocol", "tcp").lower())
+        pr = c.params.get("port-range", "1,65535").split(",")
+        default = c.params.get("default", "allow")
+        g.add_rule(AclRule(c.alias, net, proto, int(pr[0]), int(pr[1]),
+                           default == "allow"))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return [r.alias for r in g.rules]
+        return [f"{r.alias} -> allow {r.network} protocol {r.protocol.value} "
+                f"port [{r.min_port},{r.max_port}] {'allow' if r.allow else 'deny'}"
+                for r in g.rules]
+    if c.action in ("remove", "force-remove"):
+        try:
+            g.remove_rule(c.alias)
+        except KeyError:
+            raise CmdError(f"security-group-rule {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for security-group-rule")
+
+
+def _h_tl(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.tcp_lbs:
+            raise CmdError(f"tcp-lb {c.alias} already exists")
+        ip, port = _addr(c.params["address"])
+        ups = _need(app.upstreams, c.params["upstream"], "upstream")
+        aelg = app.elgs[c.params["aelg"]] if "aelg" in c.params else app.acceptor_elg
+        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
+        secg = (app.security_groups[c.params["secg"]]
+                if "secg" in c.params else None)
+        if "secg" in c.params and secg is None:
+            raise CmdError(f"security-group {c.params['secg']!r} not found")
+        lb = TcpLB(c.alias, aelg, elg, ip, port, ups,
+                   protocol=c.params.get("protocol", "tcp"),
+                   security_group=secg,
+                   in_buffer_size=int(c.params.get("in-buffer-size", 16384)))
+        lb.start()
+        app.tcp_lbs[c.alias] = lb
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.tcp_lbs.keys())
+        return [f"{lb.alias} -> acceptor {lb.acceptor.name} worker {lb.worker.name} "
+                f"bind {lb.bind_ip}:{lb.bind_port} backend {lb.backend.alias} "
+                f"in-buffer-size {lb.in_buffer_size} protocol {lb.protocol} "
+                f"security-group {lb.security_group.alias}"
+                for lb in app.tcp_lbs.values()]
+    if c.action == "update":
+        lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
+        if "in-buffer-size" in c.params:
+            lb.in_buffer_size = int(c.params["in-buffer-size"])
+        if "secg" in c.params:
+            lb.security_group = _need(app.security_groups, c.params["secg"],
+                                      "security-group")
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
+        lb.stop()
+        del app.tcp_lbs[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for tcp-lb")
+
+
+def _h_socks5(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.socks5_servers:
+            raise CmdError(f"socks5-server {c.alias} already exists")
+        ip, port = _addr(c.params["address"])
+        ups = _need(app.upstreams, c.params["upstream"], "upstream")
+        aelg = app.elgs[c.params["aelg"]] if "aelg" in c.params else app.acceptor_elg
+        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
+        secg = (app.security_groups[c.params["secg"]]
+                if "secg" in c.params else None)
+        s = Socks5Server(c.alias, aelg, elg, ip, port, ups,
+                         security_group=secg,
+                         allow_non_backend="allow-non-backend" in c.flags,
+                         in_buffer_size=int(c.params.get("in-buffer-size", 16384)))
+        s.start()
+        app.socks5_servers[c.alias] = s
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.socks5_servers.keys())
+        return [f"{s.alias} -> bind {s.bind_ip}:{s.bind_port} backend {s.backend.alias} "
+                f"{'allow' if s.allow_non_backend else 'deny'}-non-backend"
+                for s in app.socks5_servers.values()]
+    if c.action == "update":
+        s = _need(app.socks5_servers, c.alias, "socks5-server")
+        if "allow-non-backend" in c.flags:
+            s.allow_non_backend = True
+        if "deny-non-backend" in c.flags:
+            s.allow_non_backend = False
+        if "in-buffer-size" in c.params:
+            s.in_buffer_size = int(c.params["in-buffer-size"])
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        s = _need(app.socks5_servers, c.alias, "socks5-server")
+        s.stop()
+        del app.socks5_servers[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for socks5-server")
+
+
+def _h_dns(app: Application, c: Command):
+    if c.action == "add":
+        if c.alias in app.dns_servers:
+            raise CmdError(f"dns-server {c.alias} already exists")
+        ip, port = _addr(c.params["address"])
+        ups = _need(app.upstreams, c.params["upstream"], "upstream")
+        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
+        secg = (app.security_groups[c.params["secg"]]
+                if "secg" in c.params else None)
+        d = DNSServer(c.alias, elg.next(), ip, port, ups,
+                      ttl=int(c.params.get("ttl", 0)), security_group=secg)
+        d.start()
+        app.dns_servers[c.alias] = d
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.dns_servers.keys())
+        return [f"{d.alias} -> bind {d.bind_ip}:{d.bind_port} rrsets {d.rrsets.alias} "
+                f"ttl {d.ttl}" for d in app.dns_servers.values()]
+    if c.action == "update":
+        d = _need(app.dns_servers, c.alias, "dns-server")
+        if "ttl" in c.params:
+            d.ttl = int(c.params["ttl"])
+        return "OK"
+    if c.action in ("remove", "force-remove"):
+        d = _need(app.dns_servers, c.alias, "dns-server")
+        d.stop()
+        del app.dns_servers[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for dns-server")
+
+
+def _all_lbs(app: Application) -> dict:
+    out: dict = {}
+    out.update(app.tcp_lbs)
+    out.update(app.socks5_servers)
+    return out
+
+
+def _stat_target(app: Application, c: Command):
+    """Resolve `in ...` chain for statistics channels."""
+    if not c.contexts:
+        raise CmdError(f"{c.type} requires an `in` chain")
+    kind, alias = c.contexts[0]
+    if kind in ("tcp-lb", "socks5-server"):
+        return _need(_all_lbs(app), alias, kind)
+    if kind == "server":
+        if len(c.contexts) < 2 or c.contexts[1][0] != "server-group":
+            raise CmdError("server stats require `in server-group`")
+        sg = _need(app.server_groups, c.contexts[1][1], "server-group")
+        for s in sg.servers:
+            if s.name == alias:
+                return s
+        raise CmdError(f"server {alias!r} not found")
+    raise CmdError(f"stats not supported on {kind}")
+
+
+def _h_stats(app: Application, c: Command):
+    t = _stat_target(app, c)
+    if c.type == "bytes-in":
+        return [str(getattr(t, "bytes_in", 0))]
+    if c.type == "bytes-out":
+        return [str(getattr(t, "bytes_out", 0))]
+    if c.type == "accepted-conn-count":
+        return [str(getattr(t, "accepted", 0))]
+    raise CmdError(f"unsupported stat {c.type}")
+
+
+_HANDLERS = {
+    "event-loop-group": _h_elg,
+    "event-loop": _h_el,
+    "upstream": _h_ups,
+    "server-group": _h_sg,
+    "server": _h_svr,
+    "security-group": _h_secg,
+    "security-group-rule": _h_secgr,
+    "tcp-lb": _h_tl,
+    "socks5-server": _h_socks5,
+    "dns-server": _h_dns,
+    "bytes-in": _h_stats,
+    "bytes-out": _h_stats,
+    "accepted-conn-count": _h_stats,
+}

@@ -1,0 +1,188 @@
+"""HttpController — REST control surface.
+
+Parity: app controller/HttpController.java (routes :59-320, swagger
+doc/api.yaml): CRUD under /api/v1/module/<resource>, /healthz, plus a
+raw command endpoint. JSON bodies use the command grammar's param names;
+results of list endpoints are JSON arrays.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..net.connection import Connection, Handler, ServerSock
+from ..net.eventloop import SelectorEventLoop
+from ..processors.http1 import HeadParser
+from .app import Application
+from .command import CmdError, Command
+
+# url segment -> command resource type
+MODULES = {
+    "tcp-lb": "tcp-lb", "socks5-server": "socks5-server",
+    "dns-server": "dns-server", "event-loop-group": "event-loop-group",
+    "upstream": "upstream", "server-group": "server-group",
+    "security-group": "security-group", "cert-key": "cert-key",
+}
+FLAG_KEYS = {"allow-non-backend", "deny-non-backend"}
+
+
+def _resp(status: int, body, ctype: str = "application/json") -> bytes:
+    if isinstance(body, (dict, list)):
+        data = json.dumps(body).encode()
+    elif isinstance(body, str):
+        data = body.encode()
+    else:
+        data = body or b""
+    reason = {200: "OK", 204: "No Content", 400: "Bad Request",
+              404: "Not Found", 405: "Method Not Allowed",
+              500: "Internal Server Error"}.get(status, "OK")
+    head = (f"HTTP/1.1 {status} {reason}\r\ncontent-type: {ctype}\r\n"
+            f"content-length: {len(data)}\r\nconnection: close\r\n\r\n")
+    return head.encode() + data
+
+
+class _HttpConn(Handler):
+    def __init__(self, ctl: "HttpController", conn: Connection):
+        self.ctl = ctl
+        self.conn = conn
+        self.parser = HeadParser()
+        self.body = b""
+        conn.set_handler(self)
+
+    def on_data(self, conn: Connection, data: bytes) -> None:
+        if not self.parser.done:
+            self.parser.feed(data)
+            if self.parser.error:
+                conn.write(_resp(400, {"error": self.parser.error}))
+                self.ctl.loop.delay(50, conn.close)
+                return
+            if not self.parser.done:
+                return
+            self.body = bytes(self.parser.buf[self.parser.head_len:])
+        else:
+            self.body += data
+        cl = int(self.parser.header("content-length") or 0)
+        if len(self.body) < cl:
+            return
+        status, payload = self._route(self.parser.method,
+                                      self.parser.uri, self.body[:cl])
+        conn.write(_resp(status, payload))
+        self.ctl.loop.delay(50, conn.close)
+
+    def _route(self, method: str, uri: str, body: bytes):
+        app = self.ctl.app
+        path = uri.split("?")[0].rstrip("/")
+        try:
+            if path == "/healthz":
+                return 200, {"status": "ok"}
+            if path == "/api/v1/command" and method == "POST":
+                cmd = json.loads(body or b"{}").get("command", "")
+                result = Command.execute(app, cmd)
+                return 200, {"result": result}
+            parts = [p for p in path.split("/") if p]
+            # /api/v1/module/<type>[/<name>]
+            if len(parts) >= 4 and parts[0] == "api" and parts[1] == "v1" \
+                    and parts[2] == "module" and parts[3] in MODULES:
+                rtype = MODULES[parts[3]]
+                name = parts[4] if len(parts) > 4 else None
+                sub = parts[5:] if len(parts) > 5 else []
+                return self._module(method, rtype, name, sub, body)
+            return 404, {"error": f"no such endpoint {path}"}
+        except CmdError as e:
+            return 400, {"error": str(e)}
+        except json.JSONDecodeError as e:
+            return 400, {"error": f"bad json: {e}"}
+        except Exception as e:
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+
+    @staticmethod
+    def _cmdline(action: str, rtype: str, name: str, params: dict) -> str:
+        toks = [action, rtype, name]
+        for k, v in params.items():
+            if k in FLAG_KEYS:
+                if v:
+                    toks.append(k)
+            elif k == "annotations":
+                toks += [k, json.dumps(v, separators=(",", ":"))
+                         if isinstance(v, dict) else str(v)]
+            else:
+                toks += [k, str(v)]
+        return " ".join(toks)
+
+    def _module(self, method: str, rtype: str, name, sub, body: bytes):
+        app = self.ctl.app
+        if method == "GET":
+            if name is None:
+                return 200, Command.execute(app, f"list-detail {rtype}")
+            # sub-resource listing e.g. /server-group/sg0/server
+            if sub:
+                return 200, Command.execute(
+                    app, f"list-detail {sub[0]} in {rtype} {name}")
+            detail = Command.execute(app, f"list-detail {rtype}")
+            for line in detail:
+                if line.split(" ")[0] == name:
+                    return 200, {"name": name, "detail": line}
+            return 404, {"error": f"{rtype} {name} not found"}
+        if method == "POST":
+            params = json.loads(body or b"{}")
+            if name is None:
+                name = params.pop("name", None)
+                if not name:
+                    return 400, {"error": "name required"}
+            if sub:  # POST /module/server-group/sg0/server {name, address,...}
+                sname = params.pop("name", None)
+                line = self._cmdline("add", sub[0], sname, params)
+                line += f" to {rtype} {name}"
+                return 200, {"result": Command.execute(app, line)}
+            return 200, {"result": Command.execute(
+                app, self._cmdline("add", rtype, name, params))}
+        if method == "PUT":
+            if name is None:
+                return 405, {"error": "PUT requires a resource name"}
+            params = json.loads(body or b"{}")
+            return 200, {"result": Command.execute(
+                app, self._cmdline("update", rtype, name, params))}
+        if method == "DELETE":
+            if name is None:
+                return 405, {"error": "DELETE requires a resource name"}
+            if sub:
+                return 200, {"result": Command.execute(
+                    app, f"remove {sub[0]} {sub[1]} from {rtype} {name}")}
+            return 200, {"result": Command.execute(app, f"force-remove {rtype} {name}")}
+        return 405, {"error": f"method {method} not allowed"}
+
+
+class HttpController:
+    def __init__(self, app: Application, bind_ip: str, bind_port: int,
+                 loop: Optional[SelectorEventLoop] = None):
+        self.app = app
+        self.loop = loop or app.control_loop
+        self.bind_ip, self.bind_port = bind_ip, bind_port
+        self._srv: Optional[ServerSock] = None
+
+    def start(self) -> None:
+        done = []
+
+        def mk() -> None:
+            try:
+                self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
+                                       self._on_accept)
+                self.bind_port = self._srv.port
+            finally:
+                done.append(1)
+        self.loop.run_on_loop(mk)
+        import time
+        t0 = time.time()
+        while not done and time.time() - t0 < 5:
+            time.sleep(0.002)
+        if self._srv is None:
+            raise OSError("http-controller bind failed")
+
+    def _on_accept(self, fd: int, ip: str, port: int) -> None:
+        _HttpConn(self, Connection(self.loop, fd, (ip, port)))
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            srv = self._srv
+            self._srv = None
+            self.loop.run_on_loop(srv.close)

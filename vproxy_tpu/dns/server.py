@@ -1,0 +1,167 @@
+"""DNSServer — authoritative + recursive DNS over UDP, with DNS-as-LB.
+
+Parity: core dns/DNSServer.java. Lookup order per qname
+(DNSServer.java:116-195): hosts map -> rrsets (an Upstream searched with
+Hint.ofHost(domain) — the classify engine) -> IP-literal echo ->
+recursive upstream via DNSClient. A/AAAA answers pick a HEALTHY backend
+via the matched group's nextIPv4/nextIPv6 (DNS answers load-balance);
+SRV lists all healthy server handles with weights. Queries are gated by
+a SecurityGroup (UDP protocol).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..components.secgroup import SecurityGroup
+from ..components.upstream import Upstream
+from ..net import vtl
+from ..net.eventloop import SelectorEventLoop
+from ..rules.ir import Hint, Proto
+from ..utils.ip import is_ip_literal, parse_ip
+from . import packet as P
+from .client import DNSClient
+
+
+class DNSServer:
+    def __init__(self, alias: str, loop: SelectorEventLoop, bind_ip: str,
+                 bind_port: int, rrsets: Upstream, ttl: int = 0,
+                 security_group: Optional[SecurityGroup] = None,
+                 recursive_client: Optional[DNSClient] = None,
+                 hosts: Optional[dict[str, bytes]] = None):
+        self.alias = alias
+        self.loop = loop
+        self.bind_ip = bind_ip
+        self.bind_port = bind_port
+        self.rrsets = rrsets
+        self.ttl = ttl
+        self.security_group = security_group or SecurityGroup.allow_all()
+        self.recursive = recursive_client
+        self.hosts = hosts or {}
+        self._fd: Optional[int] = None
+        self.started = False
+        self.queries = 0
+
+    # ------------------------------------------------------------ control
+
+    def start(self) -> None:
+        if self.started:
+            return
+        done = []
+
+        def mk() -> None:
+            try:
+                self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
+                if self.bind_port == 0:
+                    _, self.bind_port = vtl.sock_name(self._fd)
+                self.loop.add(self._fd, vtl.EV_READ, self._on_readable)
+            finally:
+                done.append(1)
+        self.loop.run_on_loop(mk)
+        import time
+        t0 = time.time()
+        while not done and time.time() - t0 < 5:
+            time.sleep(0.002)
+        if self._fd is None:
+            raise OSError(f"dns-server {self.alias}: bind failed")
+        self.started = True
+
+    def stop(self) -> None:
+        if not self.started:
+            return
+        self.started = False
+        fd = self._fd
+        self._fd = None
+
+        def rm() -> None:
+            if fd is not None:
+                self.loop.remove(fd)
+                vtl.close(fd)
+        self.loop.run_on_loop(rm)
+
+    # --------------------------------------------------------- data plane
+
+    def _on_readable(self, fd: int, ev: int) -> None:
+        while self._fd is not None:
+            r = vtl.recvfrom(fd)
+            if r is None:
+                return
+            data, ip, port = r
+            self.queries += 1
+            if not self.security_group.allow(Proto.UDP, parse_ip(ip), self.bind_port):
+                continue
+            try:
+                req = P.parse(data)
+            except P.DNSFormatError:
+                continue
+            self._handle(req, ip, port)
+
+    def _respond(self, req: P.Packet, ip: str, port: int,
+                 answers: list, rcode: int = 0) -> None:
+        resp = P.Packet(id=req.id, is_resp=True, aa=rcode == 0, rd=req.rd,
+                        ra=self.recursive is not None, rcode=rcode,
+                        questions=list(req.questions), answers=answers)
+        if self._fd is not None:
+            vtl.sendto(self._fd, resp.encode(), ip, port)
+
+    def _handle(self, req: P.Packet, ip: str, port: int) -> None:
+        if not req.questions:
+            self._respond(req, ip, port, [], rcode=1)
+            return
+        answers: list[P.Record] = []
+        for q in req.questions:
+            if q.qtype not in (P.A, P.AAAA, P.SRV, P.ANY):
+                self._run_recursive(req, ip, port)
+                return
+            domain = q.qname.rstrip(".")
+            host_hit = self.hosts.get(domain)
+            if host_hit is not None:
+                answers.append(self._addr_record(q.qname, host_hit))
+                continue
+            gh = self.rrsets.search_for_group(Hint.of_host(domain))
+            if gh is None:
+                if is_ip_literal(domain):
+                    addr = parse_ip(domain)
+                    if ((q.qtype == P.A and len(addr) == 4)
+                            or (q.qtype == P.AAAA and len(addr) == 16)
+                            or q.qtype == P.SRV):
+                        answers.append(self._addr_record(q.qname, addr))
+                    continue
+                self._run_recursive(req, ip, port)
+                return
+            if q.qtype == P.SRV:
+                for svr in gh.group.servers:
+                    if not svr.healthy:
+                        continue
+                    answers.append(P.Record(
+                        name=q.qname, rtype=P.SRV, ttl=self.ttl,
+                        rdata=(0, svr.weight, svr.port,
+                               (svr.host_name or svr.ip) + ".")))
+            else:
+                fam = "v4" if q.qtype == P.A else ("v6" if q.qtype == P.AAAA else None)
+                conn = gh.group.next(parse_ip(ip), fam)
+                if conn is None:
+                    continue  # no healthy server: empty answer section
+                answers.append(self._addr_record(q.qname, parse_ip(conn.ip)))
+        self._respond(req, ip, port, answers)
+
+    def _addr_record(self, qname: str, addr: bytes) -> P.Record:
+        return P.Record(name=qname, rtype=P.A if len(addr) == 4 else P.AAAA,
+                        ttl=self.ttl, rdata=addr)
+
+    def _run_recursive(self, req: P.Packet, ip: str, port: int) -> None:
+        if self.recursive is None or not req.questions:
+            self._respond(req, ip, port, [], rcode=3)  # NXDOMAIN
+            return
+        q = req.questions[0]
+
+        def on_resp(resp: Optional[P.Packet], err) -> None:
+            if resp is None:
+                self._respond(req, ip, port, [], rcode=2)  # SERVFAIL
+                return
+            resp.id = req.id
+            resp.is_resp = True
+            resp.ra = True
+            if self._fd is not None:
+                vtl.sendto(self._fd, resp.encode(), ip, port)
+
+        self.recursive.query(q.qname, q.qtype, on_resp)

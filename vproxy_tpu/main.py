@@ -1,0 +1,144 @@
+"""Process entry — `python -m vproxy_tpu`.
+
+Parity: app/Main.java: default controllers (resp on 16309, http on
+18776, both on 127.0.0.1 — Main.java:319-337), load-last-config at boot,
+hourly auto-save, signal-triggered graceful save+exit, stdio REPL.
+
+Args (subset of the reference's op grammar, app/args/*):
+  resp-controller <addr> <password>   start RESP controller there
+  http-controller <addr>              start HTTP controller there
+  allowSystemCommandInNonStdIOController (accepted, no-op)
+  load <file>            load a config file instead of the default
+  noLoadLast             do not load the last config
+  noSave                 disable auto/exit saving
+  noStdIOController      do not start the stdin REPL
+  workers <n>            worker event loops (default: cpu count)
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+from .control import persist
+from .control.app import Application
+from .control.command import CmdError, Command
+from .control.http_controller import HttpController
+from .control.resp import RESPController
+
+DEFAULT_RESP = ("127.0.0.1", 16309)
+DEFAULT_HTTP = ("127.0.0.1", 18776)
+
+
+def _addr(s: str):
+    h, _, p = s.rpartition(":")
+    return h or "127.0.0.1", int(p)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = {"resp": DEFAULT_RESP, "resp_pass": None, "http": DEFAULT_HTTP,
+            "load": None, "no_load": False, "no_save": False,
+            "no_stdio": False, "workers": None}
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "resp-controller":
+            opts["resp"] = _addr(argv[i + 1])
+            opts["resp_pass"] = argv[i + 2]
+            i += 3
+        elif a == "http-controller":
+            opts["http"] = _addr(argv[i + 1])
+            i += 2
+        elif a == "load":
+            opts["load"] = argv[i + 1]
+            i += 2
+        elif a == "noLoadLast":
+            opts["no_load"] = True
+            i += 1
+        elif a == "noSave":
+            opts["no_save"] = True
+            i += 1
+        elif a == "noStdIOController":
+            opts["no_stdio"] = True
+            i += 1
+        elif a == "workers":
+            opts["workers"] = int(argv[i + 1])
+            i += 2
+        elif a in ("allowSystemCommandInNonStdIOController", "noStartupBindCheck"):
+            i += 1
+        elif a in ("version", "-version", "--version"):
+            print("vproxy-tpu 0.1.0")
+            return 0
+        else:
+            print(f"unknown argument {a!r}", file=sys.stderr)
+            return 1
+
+    app = Application.create(workers=opts["workers"])
+    try:
+        resp = RESPController(app, opts["resp"][0], opts["resp"][1],
+                              password=opts["resp_pass"])
+        resp.start()
+        http = HttpController(app, opts["http"][0], opts["http"][1])
+        http.start()
+    except OSError as e:
+        print(f"failed to start controllers: {e}", file=sys.stderr)
+        app.close()
+        return 1
+    print(f"resp-controller on {opts['resp'][0]}:{resp.bind_port}")
+    print(f"http-controller on {opts['http'][0]}:{http.bind_port}")
+
+    if opts["load"]:
+        n = persist.load(app, opts["load"])
+        print(f"loaded {n} commands from {opts['load']}")
+    elif not opts["no_load"] and os.path.exists(persist.LAST_CONFIG):
+        n = persist.load(app)
+        print(f"loaded {n} commands from {persist.LAST_CONFIG}")
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        if not opts["no_save"]:
+            try:
+                persist.save(app)
+            except OSError as e:
+                print(f"save failed: {e}", file=sys.stderr)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2, lambda s, f: persist.save(app))
+
+    if not opts["no_save"]:
+        persist.start_auto_save(app)
+
+    if not opts["no_stdio"]:
+        def repl() -> None:
+            for line in sys.stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                if line in ("exit", "quit", "System: exit"):
+                    on_signal(None, None)
+                    return
+                try:
+                    result = Command.execute(app, line)
+                    if isinstance(result, list):
+                        for j, item in enumerate(result):
+                            print(f"{j + 1}) {item!r}")
+                    else:
+                        print(f"{result!r}")
+                except CmdError as e:
+                    print(f"error: {e}")
+            on_signal(None, None)
+        threading.Thread(target=repl, daemon=True, name="stdio").start()
+
+    stop.wait()
+    app.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -539,7 +539,13 @@ int vtl_poll(void* lp, uint64_t* tags, uint32_t* evs, int max,
 void vtl_free(void* lp) {
   Loop* l = (Loop*)lp;
   for (Handler* g : l->garbage) delete g;
-  for (auto& kv : l->pumps) delete kv.second;
+  for (auto& kv : l->pumps) {
+    if (!kv.second->dead) {  // live spliced sessions: close both fds
+      close(kv.second->fd_a);
+      close(kv.second->fd_b);
+    }
+    delete kv.second;
+  }
   for (auto& kv : l->handlers) delete kv.second;
   if (l->ep >= 0) close(l->ep);
   if (l->wakefd >= 0) close(l->wakefd);

@@ -105,20 +105,27 @@ class SelectorEventLoop:
 
     # ------------------------------------------------------------ registry
 
+    def _alive(self) -> bool:
+        return not self._closed and self._lp is not None
+
     def add(self, fd: int, events: int, cb: Callable[[int, int], None]) -> None:
         """cb(fd, events) fires on readiness. Loop thread only."""
+        if not self._alive():
+            raise OSError("event loop is closed")
         tag = next(self._taggen)
         vtl.check(vtl.LIB.vtl_add(self._lp, fd, events, tag))
         self._handlers[tag] = (fd, cb)
         self._fd_tags[fd] = tag
 
     def modify(self, fd: int, events: int) -> None:
+        if not self._alive():
+            return
         tag = self._fd_tags[fd]
         vtl.check(vtl.LIB.vtl_mod(self._lp, fd, events, tag))
 
     def remove(self, fd: int) -> None:
         tag = self._fd_tags.pop(fd, None)
-        if tag is None:
+        if tag is None or not self._alive():
             return
         vtl.LIB.vtl_del(self._lp, fd)
         self._handlers.pop(tag, None)
@@ -133,6 +140,8 @@ class SelectorEventLoop:
         """Hand both fds to the native splice engine. The loop owns the fds
         from here; on_done(bytes_a2b, bytes_b2a, err) fires when the session
         dies. Any python registration for these fds must be removed first."""
+        if not self._alive():
+            raise OSError("event loop is closed")
         pid = vtl.LIB.vtl_pump_new(self._lp, fd_a, fd_b, bufsize)
         if pid == 0:
             raise OSError("pump: fds busy")
@@ -154,12 +163,15 @@ class SelectorEventLoop:
 
     def run_on_loop(self, fn: Callable[[], None]) -> None:
         """Thread-safe submit + wakeup."""
+        if not self._alive():
+            return  # loop is gone; drop the task (reference logs + ignores)
         if threading.current_thread() is self._thread:
             self.next_tick(fn)
             return
         with self._xq_lock:
             self._xq.append(fn)
-        vtl.LIB.vtl_wakeup(self._lp)
+        if self._lp is not None:
+            vtl.LIB.vtl_wakeup(self._lp)
 
     def delay(self, ms: int, fn: Callable[[], None]) -> TimerEvent:
         t = TimerEvent(time.monotonic() + ms / 1000.0, fn, next(self._seq))
@@ -240,8 +252,18 @@ class SelectorEventLoop:
         if self._thread is not None and self._thread is not threading.current_thread():
             vtl.LIB.vtl_wakeup(self._lp)
             self._thread.join(timeout=5)
-        for fd in list(self._fd_tags):
-            self.remove(fd)
-            vtl.close(fd)
-        vtl.LIB.vtl_free(self._lp)
+            if self._thread.is_alive():
+                # loop thread is wedged in a handler: freeing the native loop
+                # under it would be a use-after-free — leak it instead
+                import sys
+                print(f"loop {self.name}: thread did not exit; leaking native "
+                      f"loop", file=sys.stderr)
+                return
+        lp = self._lp
         self._lp = None
+        for fd in list(self._fd_tags):
+            self._fd_tags.pop(fd, None)
+            vtl.LIB.vtl_del(lp, fd)
+            vtl.close(fd)
+        self._handlers.clear()
+        vtl.LIB.vtl_free(lp)
