@@ -20,3 +20,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 os.environ["PYTHONPATH"] = ":".join(
     p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p)
+
+# The axon sitecustomize pre-imports jax at interpreter start, freezing
+# jax_platforms=axon before the env vars above exist. The backend itself
+# is created lazily, so overriding the config value here (before any
+# jax.devices() call) still lands the tests on the 8-device virtual CPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

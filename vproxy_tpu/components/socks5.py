@@ -9,13 +9,15 @@ After the reply, the session drops into the native splice pump.
 """
 from __future__ import annotations
 
+import socket
 import struct
+import threading
 from typing import Optional
 
 from ..net import vtl
 from ..net.connection import Connection, Handler, ServerSock
 from ..rules.ir import Hint, Proto
-from ..utils.ip import format_ip, parse_ip
+from ..utils.ip import format_ip, is_ip_literal, parse_ip
 from .elgroup import EventLoopGroup
 from .secgroup import SecurityGroup
 from .servergroup import Connector
@@ -156,20 +158,61 @@ class _Socks5Session(Handler):
             self.loop.delay(20, conn.close)
 
     def _connect_and_splice(self, conn: Connection, connector, target) -> None:
-        lb = self.server
-        session = self
         svr = connector.svr if connector else None
         if svr is not None:
             svr.conn_count += 1
-        lb.active_sessions += 1
+        self.server.active_sessions += 1
+        # stop pulling client bytes into python: whatever is already in
+        # session.buf is flushed to the backend at handover; everything
+        # later stays in the kernel buffer for the pump
+        conn.pause_reading()
+        host, port = target
+        if is_ip_literal(host):
+            self._do_connect(conn, svr, host, port, self._mk_release(svr))
+            return
+        # direct (allow_non_backend) domain target: resolve off-loop, then
+        # continue on the loop (Socks5Server.java resolves via Resolver)
+        release = self._mk_release(svr)
+
+        def resolve() -> None:
+            try:
+                infos = socket.getaddrinfo(host, port, type=socket.SOCK_STREAM)
+                ip = infos[0][4][0]
+            except OSError:
+                ip = None
+            self.loop.run_on_loop(lambda: cont(ip))
+
+        def cont(ip: Optional[str]) -> None:
+            if conn.closed:
+                release()
+                return
+            if ip is None:
+                release()
+                self._reply(conn, REP_HOST_UNREACH)
+                return
+            self._do_connect(conn, svr, ip, port, release)
+
+        threading.Thread(target=resolve, name="socks5-resolve", daemon=True).start()
+
+    def _mk_release(self, svr):
+        lb = self.server
+        released = [False]
 
         def release() -> None:
+            if released[0]:
+                return
+            released[0] = True
             if svr is not None:
                 svr.conn_count -= 1
             lb.active_sessions -= 1
+        return release
 
+    def _do_connect(self, conn: Connection, svr, ip: str, port: int,
+                    release) -> None:
+        lb = self.server
+        session = self
         try:
-            back = Connection.connect(self.loop, target[0], target[1])
+            back = Connection.connect(self.loop, ip, port)
         except OSError:
             release()
             self._reply(conn, REP_HOST_UNREACH)
@@ -191,7 +234,12 @@ class _Socks5Session(Handler):
                 self._handover(bconn)
 
             def _handover(self, bconn: Connection) -> None:
-                if bconn.detached or bconn.closed or conn.closed:
+                if bconn.detached or bconn.closed:
+                    return
+                if conn.closed:
+                    # client went away before handover: drop the backend
+                    # (on_closed below releases the counters)
+                    bconn.close()
                     return
                 ffd = conn.detach()
                 bfd = bconn.detach()

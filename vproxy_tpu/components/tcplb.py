@@ -14,7 +14,6 @@ before splicing.
 """
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 from ..net import vtl
@@ -62,15 +61,12 @@ class TcpLB:
         if self.started:
             return
         self.started = True
-        errors: list[OSError] = []
         loops = self.acceptor.loops
-        # bind the first loop alone so an ephemeral port (bind_port=0) is
+        # bind loops one at a time so an ephemeral port (bind_port=0) is
         # resolved once and the remaining loops share it via REUSEPORT
-        for lp in loops:
-            ev = threading.Event()
-
-            def mk(lp=lp) -> None:
-                try:
+        try:
+            for lp in loops:
+                def mk(lp=lp) -> None:
                     ss = ServerSock(
                         lp, self.bind_ip, self.bind_port,
                         lambda fd, ip, port, lp=lp: self._on_accept(lp, fd, ip, port),
@@ -78,21 +74,13 @@ class TcpLB:
                     self.server_socks.append(ss)
                     if self.bind_port == 0:
                         self.bind_port = ss.port
-                except OSError as e:
-                    errors.append(e)
-                finally:
-                    ev.set()
-            lp.run_on_loop(mk)
-            if not ev.wait(5):
-                errors.append(OSError("bind timeout"))
-            if errors:
-                break
-        if errors or len(self.server_socks) < len(loops):
+                lp.call_sync(mk)
+        except OSError as e:
             self.stop()
             self.started = False
             raise OSError(
                 f"tcp-lb {self.alias}: bind failed on "
-                f"{self.bind_ip}:{self.bind_port}: {errors[:1] or 'timeout'}")
+                f"{self.bind_ip}:{self.bind_port}: {e}") from e
 
     def stop(self) -> None:
         if not self.started:
@@ -145,6 +133,7 @@ class TcpLB:
         def sweep() -> None:
             st = self._pump_watch.get(id(loop), {})
             if not st or not self.started:
+                self._sweep_armed.discard(id(loop))
                 return
             for pid, (last_total, last_ts) in list(st.items()):
                 try:

@@ -170,6 +170,18 @@ def _need(app_dict: dict, alias: str, kind: str):
     return app_dict[alias]
 
 
+def _opt_elg(app: Application, c: Command, key: str, default):
+    if key not in c.params:
+        return default
+    return _need(app.elgs, c.params[key], "event-loop-group")
+
+
+def _opt_secg(app: Application, c: Command):
+    if "secg" not in c.params:
+        return None
+    return _need(app.security_groups, c.params["secg"], "security-group")
+
+
 def _addr(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     if host.startswith("[") and host.endswith("]"):
@@ -280,7 +292,7 @@ def _h_sg(app: Application, c: Command):
             up=int(c.params.get("up", 2)),
             down=int(c.params.get("down", 3)),
             protocol=c.params.get("protocol", "tcp"))
-        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
+        elg = _opt_elg(app, c, "elg", app.worker_elg)
         anno = _anno_to_rule(c.params["annotations"]) if "annotations" in c.params else None
         app.server_groups[c.alias] = ServerGroup(
             c.alias, elg, hc, c.params.get("method", "wrr"), anno)
@@ -320,7 +332,7 @@ def _h_sg(app: Application, c: Command):
                 period_ms=int(c.params.get("period", sg.hc.period_ms)),
                 up=int(c.params.get("up", sg.hc.up)),
                 down=int(c.params.get("down", sg.hc.down)),
-                protocol=c.params.get("protocol", "tcp"))
+                protocol=c.params.get("protocol", sg.hc.protocol))
         if "method" in c.params:
             if c.params["method"] not in ServerGroup.METHODS:
                 raise CmdError(f"unknown method {c.params['method']}")
@@ -443,12 +455,9 @@ def _h_tl(app: Application, c: Command):
             raise CmdError(f"tcp-lb {c.alias} already exists")
         ip, port = _addr(c.params["address"])
         ups = _need(app.upstreams, c.params["upstream"], "upstream")
-        aelg = app.elgs[c.params["aelg"]] if "aelg" in c.params else app.acceptor_elg
-        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
-        secg = (app.security_groups[c.params["secg"]]
-                if "secg" in c.params else None)
-        if "secg" in c.params and secg is None:
-            raise CmdError(f"security-group {c.params['secg']!r} not found")
+        aelg = _opt_elg(app, c, "aelg", app.acceptor_elg)
+        elg = _opt_elg(app, c, "elg", app.worker_elg)
+        secg = _opt_secg(app, c)
         lb = TcpLB(c.alias, aelg, elg, ip, port, ups,
                    protocol=c.params.get("protocol", "tcp"),
                    security_group=secg,
@@ -486,10 +495,9 @@ def _h_socks5(app: Application, c: Command):
             raise CmdError(f"socks5-server {c.alias} already exists")
         ip, port = _addr(c.params["address"])
         ups = _need(app.upstreams, c.params["upstream"], "upstream")
-        aelg = app.elgs[c.params["aelg"]] if "aelg" in c.params else app.acceptor_elg
-        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
-        secg = (app.security_groups[c.params["secg"]]
-                if "secg" in c.params else None)
+        aelg = _opt_elg(app, c, "aelg", app.acceptor_elg)
+        elg = _opt_elg(app, c, "elg", app.worker_elg)
+        secg = _opt_secg(app, c)
         s = Socks5Server(c.alias, aelg, elg, ip, port, ups,
                          security_group=secg,
                          allow_non_backend="allow-non-backend" in c.flags,
@@ -526,9 +534,8 @@ def _h_dns(app: Application, c: Command):
             raise CmdError(f"dns-server {c.alias} already exists")
         ip, port = _addr(c.params["address"])
         ups = _need(app.upstreams, c.params["upstream"], "upstream")
-        elg = app.elgs[c.params["elg"]] if "elg" in c.params else app.worker_elg
-        secg = (app.security_groups[c.params["secg"]]
-                if "secg" in c.params else None)
+        elg = _opt_elg(app, c, "elg", app.worker_elg)
+        secg = _opt_secg(app, c)
         d = DNSServer(c.alias, elg.next(), ip, port, ups,
                       ttl=int(c.params.get("ttl", 0)), security_group=secg)
         d.start()

@@ -47,9 +47,14 @@ class _HttpConn(Handler):
         self.conn = conn
         self.parser = HeadParser()
         self.body = b""
+        self.handled = False
         conn.set_handler(self)
 
     def on_data(self, conn: Connection, data: bytes) -> None:
+        if self.handled:
+            # request already executed; the conn closes shortly — drop any
+            # pipelined bytes rather than re-running the command
+            return
         if not self.parser.done:
             self.parser.feed(data)
             if self.parser.error:
@@ -64,6 +69,7 @@ class _HttpConn(Handler):
         cl = int(self.parser.header("content-length") or 0)
         if len(self.body) < cl:
             return
+        self.handled = True
         status, payload = self._route(self.parser.method,
                                       self.parser.uri, self.body[:cl])
         conn.write(_resp(status, payload))
@@ -161,22 +167,11 @@ class HttpController:
         self._srv: Optional[ServerSock] = None
 
     def start(self) -> None:
-        done = []
-
         def mk() -> None:
-            try:
-                self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
-                                       self._on_accept)
-                self.bind_port = self._srv.port
-            finally:
-                done.append(1)
-        self.loop.run_on_loop(mk)
-        import time
-        t0 = time.time()
-        while not done and time.time() - t0 < 5:
-            time.sleep(0.002)
-        if self._srv is None:
-            raise OSError("http-controller bind failed")
+            self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
+                                   self._on_accept)
+            self.bind_port = self._srv.port
+        self.loop.call_sync(mk)
 
     def _on_accept(self, fd: int, ip: str, port: int) -> None:
         _HttpConn(self, Connection(self.loop, fd, (ip, port)))

@@ -151,22 +151,11 @@ class RESPController:
         self._srv: Optional[ServerSock] = None
 
     def start(self) -> None:
-        done = []
-
         def mk() -> None:
-            try:
-                self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
-                                       self._on_accept)
-                self.bind_port = self._srv.port
-            finally:
-                done.append(1)
-        self.loop.run_on_loop(mk)
-        import time
-        t0 = time.time()
-        while not done and time.time() - t0 < 5:
-            time.sleep(0.002)
-        if self._srv is None:
-            raise OSError("resp-controller bind failed")
+            self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
+                                   self._on_accept)
+            self.bind_port = self._srv.port
+        self.loop.call_sync(mk)
 
     def _on_accept(self, fd: int, ip: str, port: int) -> None:
         _RespConn(self, Connection(self.loop, fd, (ip, port)))

@@ -46,23 +46,16 @@ class DNSServer:
     def start(self) -> None:
         if self.started:
             return
-        done = []
 
         def mk() -> None:
-            try:
-                self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
-                if self.bind_port == 0:
-                    _, self.bind_port = vtl.sock_name(self._fd)
-                self.loop.add(self._fd, vtl.EV_READ, self._on_readable)
-            finally:
-                done.append(1)
-        self.loop.run_on_loop(mk)
-        import time
-        t0 = time.time()
-        while not done and time.time() - t0 < 5:
-            time.sleep(0.002)
-        if self._fd is None:
-            raise OSError(f"dns-server {self.alias}: bind failed")
+            self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
+            if self.bind_port == 0:
+                _, self.bind_port = vtl.sock_name(self._fd)
+            self.loop.add(self._fd, vtl.EV_READ, self._on_readable)
+        try:
+            self.loop.call_sync(mk)
+        except OSError as e:
+            raise OSError(f"dns-server {self.alias}: bind failed: {e}") from e
         self.started = True
 
     def stop(self) -> None:

@@ -173,6 +173,30 @@ class SelectorEventLoop:
         if self._lp is not None:
             vtl.LIB.vtl_wakeup(self._lp)
 
+    def call_sync(self, fn: Callable[[], object], timeout: float = 5.0):
+        """Run fn on the loop thread, block until it finishes, return its
+        result or re-raise its exception (the cross-thread start/bind
+        pattern: components must not touch loop state off-thread)."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        ev = threading.Event()
+        box: list = [None, None]
+
+        def run() -> None:
+            try:
+                box[0] = fn()
+            except BaseException as e:
+                box[1] = e
+            finally:
+                ev.set()
+
+        self.run_on_loop(run)
+        if not ev.wait(timeout):
+            raise OSError(f"loop {self.name}: call_sync timed out after {timeout}s")
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
     def delay(self, ms: int, fn: Callable[[], None]) -> TimerEvent:
         t = TimerEvent(time.monotonic() + ms / 1000.0, fn, next(self._seq))
         heapq.heappush(self._timers, t)
