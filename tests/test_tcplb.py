@@ -117,7 +117,8 @@ def tcp_get_id(port):
 def http_get_id(port, host, path="/"):
     c = socket.create_connection(("127.0.0.1", port), timeout=5)
     c.settimeout(5)
-    c.sendall(b"GET %s HTTP/1.1\r\nhost: %s\r\n\r\n" % (path.encode(), host.encode()))
+    c.sendall(b"GET %s HTTP/1.1\r\nhost: %s\r\nconnection: close\r\n\r\n"
+              % (path.encode(), host.encode()))
     data = b""
     while b"\r\n\r\n" not in data:
         d = c.recv(65536)

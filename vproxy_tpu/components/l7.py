@@ -1,0 +1,219 @@
+"""L7Engine — drives a Processor session over real connections.
+
+Parity: core component/proxy/ProcessorConnectionHandler.java:16 (the L7
+data pump behind every `protocol=<processor>` TcpLB): owns the frontend
+connection plus up to MAX_BACKENDS backend connections, funnels bytes
+into the ProtoSession, executes its backend selections through
+`Upstream.next` (the classify engine), and applies byte/connection
+accounting and backpressure. The reference pumps through ring buffers
+with TODO instructions; here the session pushes into Connection out
+buffers and the engine pauses reading a source whenever a sink's out
+buffer passes the high-water mark (the writable-ET analog).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from ..net.connection import Connection, Handler
+from ..processors.base import Processor, ProcessorEngine
+from ..rules.ir import Hint
+from ..utils.ip import parse_ip
+
+MAX_BACKENDS = 1024  # ProcessorConnectionHandler.java:27
+HIGH_WATER = 1 * 1024 * 1024
+
+
+class _Sel:
+    """Opaque backend selection handed back to the session; key identifies
+    the concrete backend server so sessions can pool/reuse connections."""
+
+    __slots__ = ("connector", "key")
+
+    def __init__(self, connector):
+        self.connector = connector
+        self.key = (connector.ip, connector.port)
+
+
+class L7Engine(ProcessorEngine):
+    def __init__(self, lb, loop, cfd: int, ip: str, port: int,
+                 processor: Processor):
+        self.lb = lb
+        self.loop = loop
+        self.client_ip = parse_ip(ip)
+        self.closed = False
+        self.backs: dict[int, Connection] = {}
+        self.back_svrs: dict[int, object] = {}
+        self._ids = itertools.count(1)
+        self._front_paused = False
+        self._back_paused: set[int] = set()
+        lb.active_sessions += 1
+        try:
+            self.front = Connection(loop, cfd, (ip, port))
+        except BaseException:
+            lb.active_sessions -= 1
+            from ..net import vtl
+            vtl.close(cfd)
+            raise
+        self.front.set_handler(_FrontHandler(self))
+        try:
+            self.session = processor.session(self, (ip, port))
+        except Exception:
+            self.close()
+            raise
+
+    # ----------------------------------------------------- engine interface
+
+    def select(self, hint: Optional[Hint]) -> _Sel:
+        c = self.lb.backend.next(self.client_ip, hint)
+        if c is None:
+            raise OSError("no healthy backend for hint")
+        return _Sel(c)
+
+    def open(self, sel: _Sel) -> int:
+        if self.closed:
+            raise OSError("session closed")
+        if len(self.backs) >= MAX_BACKENDS:
+            raise OSError("too many backend connections")
+        conn = Connection.connect(self.loop, sel.connector.ip,
+                                  sel.connector.port)
+        conn_id = next(self._ids)
+        self.backs[conn_id] = conn
+        svr = sel.connector.svr
+        self.back_svrs[conn_id] = svr
+        svr.conn_count += 1
+        conn.set_handler(_BackHandler(self, conn_id))
+        return conn_id
+
+    def send_front(self, data: bytes) -> None:
+        if not self.closed:
+            self.front.write(data)
+            self._check_pressure()
+
+    def send_back(self, conn_id: int, data: bytes) -> None:
+        conn = self.backs.get(conn_id)
+        if conn is not None:
+            conn.write(data)
+            self._check_pressure()
+
+    def close_back(self, conn_id: int) -> None:
+        conn = self.backs.pop(conn_id, None)
+        self._back_paused.discard(conn_id)
+        if conn is not None:
+            self._release_back(conn_id, conn)
+            conn.set_handler(Handler())  # drop session callbacks
+            conn.close_graceful()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.lb.active_sessions -= 1
+        self.lb.bytes_in += self.front.bytes_in
+        self.lb.bytes_out += self.front.bytes_out
+        self.front.set_handler(Handler())
+        self.front.close_graceful()
+        for conn_id, conn in list(self.backs.items()):
+            self._release_back(conn_id, conn)
+            conn.set_handler(Handler())
+            conn.close_graceful()
+        self.backs.clear()
+
+    def pause_front(self) -> None:
+        self._front_paused = True
+        self.front.pause_reading()
+
+    def resume_front(self) -> None:
+        self._front_paused = False
+        self.front.resume_reading()
+
+    def pause_back(self, conn_id: int) -> None:
+        conn = self.backs.get(conn_id)
+        if conn is not None:
+            self._back_paused.add(conn_id)
+            conn.pause_reading()
+
+    def resume_back(self, conn_id: int) -> None:
+        conn = self.backs.get(conn_id)
+        if conn is not None:
+            self._back_paused.discard(conn_id)
+            conn.resume_reading()
+
+    # ----------------------------------------------------------- internals
+
+    def _release_back(self, conn_id: int, conn: Connection) -> None:
+        svr = self.back_svrs.pop(conn_id, None)
+        if svr is not None:
+            svr.conn_count -= 1
+            svr.bytes_in += conn.bytes_out  # bytes we pushed toward the server
+            svr.bytes_out += conn.bytes_in
+
+    def _check_pressure(self) -> None:
+        """Sink out-buffer past high water -> pause all sources feeding it;
+        resumed from the drain callbacks."""
+        if self.closed:
+            return
+        if len(self.front.out) > HIGH_WATER:
+            for conn_id, conn in self.backs.items():
+                if conn_id not in self._back_paused:
+                    conn.pause_reading()
+        if any(len(c.out) > HIGH_WATER for c in self.backs.values()):
+            if not self._front_paused:
+                self.front.pause_reading()
+
+    def _front_drained(self) -> None:
+        for conn_id, conn in self.backs.items():
+            if conn_id not in self._back_paused:
+                conn.resume_reading()
+        self.session.on_front_drained()
+
+    def _back_drained(self, conn_id: int) -> None:
+        if not self._front_paused and \
+                all(len(c.out) <= HIGH_WATER for c in self.backs.values()):
+            self.front.resume_reading()
+        self.session.on_back_drained(conn_id)
+
+
+class _FrontHandler(Handler):
+    def __init__(self, eng: L7Engine):
+        self.eng = eng
+
+    def on_data(self, conn: Connection, data: bytes) -> None:
+        self.eng.session.on_front_data(data)
+
+    def on_eof(self, conn: Connection) -> None:
+        self.eng.session.on_front_eof()
+
+    def on_closed(self, conn: Connection, err: int) -> None:
+        self.eng.close()
+
+    def on_drained(self, conn: Connection) -> None:
+        self.eng._front_drained()
+
+
+class _BackHandler(Handler):
+    def __init__(self, eng: L7Engine, conn_id: int):
+        self.eng = eng
+        self.conn_id = conn_id
+
+    def on_connected(self, conn: Connection) -> None:
+        self.eng.session.on_back_connected(self.conn_id)
+
+    def on_data(self, conn: Connection, data: bytes) -> None:
+        self.eng.session.on_back_data(self.conn_id, data)
+
+    def on_eof(self, conn: Connection) -> None:
+        self.eng.session.on_back_eof(self.conn_id)
+
+    def on_closed(self, conn: Connection, err: int) -> None:
+        eng = self.eng
+        conn2 = eng.backs.pop(self.conn_id, None)
+        if conn2 is not None:
+            eng._release_back(self.conn_id, conn2)
+        if eng.closed:
+            return
+        if not eng.session.on_back_closed(self.conn_id, err):
+            eng.close()
+
+    def on_drained(self, conn: Connection) -> None:
+        self.eng._back_drained(self.conn_id)

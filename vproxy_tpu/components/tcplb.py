@@ -5,12 +5,13 @@ Reference: component/app/TcpLB.java — per-acceptor-loop server socks
 backend.next(clientAddr, hint) (:166-180), worker round-robin (:182-199).
 
 TPU-first data path: accept and classification decisions run in Python
-(ACL + hint through the device matchers); once the backend connection is
-up and buffered head bytes are flushed, the session drops into the
-native splice pump (C++, net/native/vtl.cpp) and never touches the
-interpreter again. protocol="tcp" splices immediately; protocol="http"
-parses the first request head for a Host/URI hint (processors/http1.py)
-before splicing.
+(ACL + hint through the device matchers). protocol="tcp" splices
+immediately through the native pump (C++, net/native/vtl.cpp) and never
+touches the interpreter again; protocol="http-splice" parses only the
+first request head for a Host/URI hint before dropping into the same
+pump; any other protocol name resolves through the processor registry
+(processors/base.py — http/http1/h2/dubbo/framed-int32) and runs the
+full per-request/per-stream L7 engine (components/l7.py).
 """
 from __future__ import annotations
 
@@ -18,10 +19,12 @@ from typing import Optional
 
 from ..net import vtl
 from ..net.connection import Connection, Handler, ServerSock
+from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
 from ..utils.ip import parse_ip
 from .elgroup import EventLoopGroup
+from .l7 import L7Engine
 from .secgroup import SecurityGroup
 from .servergroup import Connector
 from .upstream import Upstream
@@ -33,7 +36,8 @@ class TcpLB:
                  backend: Upstream, protocol: str = "tcp",
                  security_group: Optional[SecurityGroup] = None,
                  in_buffer_size: int = 65536, timeout_ms: int = 900_000):
-        if protocol not in ("tcp", "http"):
+        if protocol not in ("tcp", "http-splice") \
+                and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
         self.alias = alias
         self.acceptor = acceptor
@@ -111,8 +115,10 @@ class TcpLB:
                 vtl.close(cfd)
                 return
             self._splice(loop, cfd, conn, b"")
-        else:
+        elif self.protocol == "http-splice":
             self._http_classify(loop, cfd, ip, port)
+        else:
+            L7Engine(self, loop, cfd, ip, port, processors.get(self.protocol))
 
     # ------------------------------------------------------ idle timeout
 

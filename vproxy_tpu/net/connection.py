@@ -50,6 +50,7 @@ class Connection:
         self.bytes_in = 0
         self.bytes_out = 0
         self._connecting = connecting
+        self._closing = False
         self._interest = 0
         loop.add(fd, 0, self._on_event)
         self._want(vtl.EV_WRITE if connecting else vtl.EV_READ)
@@ -88,6 +89,17 @@ class Connection:
         self.loop.remove(self.fd)
         vtl.close(self.fd)
         self.handler.on_closed(self, err)
+
+    def close_graceful(self) -> None:
+        """Close after the out buffer drains (final flush on write-ready);
+        a hard close would drop queued response bytes."""
+        if self.closed or self.detached:
+            return
+        if not self.out:
+            self.close()
+            return
+        self._closing = True
+        self.pause_reading()
 
     def detach(self) -> int:
         """Unregister and return the raw fd (for pump handover / transfer)."""
@@ -163,6 +175,9 @@ class Connection:
         if (ev & vtl.EV_WRITE) and not (self.closed or self.detached):
             self._flush()
             if not self.out:
+                if self._closing:
+                    self.close()
+                    return
                 self._want(self._interest & ~vtl.EV_WRITE)
                 self.handler.on_drained(self)
 
