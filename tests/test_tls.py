@@ -1,0 +1,167 @@
+"""TLS termination tests — the TestSSL.java:457 analog: SNI-based cert
+selection, Host routing over TLS, and SNI-as-hint for tcp-mode relays."""
+import socket
+import ssl
+import subprocess
+
+import pytest
+
+from vproxy_tpu.components.certkey import CertKey, CertKeyHolder
+from vproxy_tpu.components.servergroup import ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.rules.ir import HintRule
+
+from test_tcplb import IdServer, fast_hc, stack, wait_healthy  # noqa: F401
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed certs: one for a.example.com, one wildcard *.w.example.com."""
+    d = tmp_path_factory.mktemp("certs")
+
+    def mk(name, cn, sans):
+        cert, key = d / f"{name}.crt", d / f"{name}.key"
+        san = ",".join(f"DNS:{s}" for s in sans)
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "2",
+             "-subj", f"/CN={cn}", "-addext", f"subjectAltName={san}"],
+            check=True, capture_output=True)
+        return str(cert), str(key)
+
+    a = mk("a", "a.example.com", ["a.example.com"])
+    w = mk("w", "*.w.example.com", ["*.w.example.com"])
+    return {"a": a, "w": w}
+
+
+def test_certkey_sni_choose(certs):
+    ck_a = CertKey("a", *certs["a"])
+    ck_w = CertKey("w", *certs["w"])
+    assert ck_a.dns_names == ["a.example.com"]
+    assert ck_w.matches("x.w.example.com")
+    assert not ck_w.matches("x.y.w.example.com")  # single-label wildcard
+    holder = CertKeyHolder([ck_a, ck_w])
+    assert holder.choose("a.example.com") is not None
+    assert holder.choose("b.w.example.com") is holder.choose("c.w.example.com")
+    assert holder.choose("unknown.org") is None  # falls back to default
+
+
+def _tls_get(port, sni, host, path="/"):
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    raw = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c = ctx.wrap_socket(raw, server_hostname=sni)
+    c.settimeout(5)
+    peer_cn = c.getpeercert(binary_form=True)
+    c.sendall(b"GET %s HTTP/1.1\r\nhost: %s\r\nconnection: close\r\n\r\n"
+              % (path.encode(), host.encode()))
+    data = b""
+    while True:
+        try:
+            d = c.recv(65536)
+        except (ssl.SSLError, socket.timeout, ConnectionResetError):
+            break
+        if not d:
+            break
+        data += d
+    c.close()
+    _, _, body = data.partition(b"\r\n\r\n")
+    return body, peer_cn
+
+
+def test_tls_terminating_lb_routes_by_host(stack, certs):
+    sa = IdServer("TA", http=True)
+    sb = IdServer("TB", http=True)
+    stack["servers"] += [sa, sb]
+    elg = stack["make_elg"](1)
+    ups = Upstream("u")
+    for i, (srv, rule) in enumerate([
+            (sa, HintRule(host="a.example.com")),
+            (sb, HintRule(host="b.w.example.com"))]):
+        g = ServerGroup(f"g{i}", elg, fast_hc())
+        stack["groups"].append(g)
+        g.add("s", "127.0.0.1", srv.port)
+        wait_healthy(g, 1)
+        ups.add(g, annotations=rule)
+    cks = [CertKey("a", *certs["a"]), CertKey("w", *certs["w"])]
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="http",
+               cert_keys=cks)
+    stack["lbs"].append(lb)
+    lb.start()
+
+    body, cert_a = _tls_get(lb.bind_port, "a.example.com", "a.example.com")
+    assert body == b"TA"
+    body, cert_w = _tls_get(lb.bind_port, "b.w.example.com", "b.w.example.com")
+    assert body == b"TB"
+    # SNI picked DIFFERENT certificates for the two names
+    assert cert_a != cert_w
+    # unknown SNI serves the default (first) cert and still proxies
+    body, cert_d = _tls_get(lb.bind_port, "other.org", "a.example.com")
+    assert body == b"TA" and cert_d == cert_a
+
+
+def test_tls_tcp_mode_uses_sni_as_hint(stack, certs):
+    sa = IdServer("RA")  # raw id servers (send id on connect)
+    sb = IdServer("RB")
+    stack["servers"] += [sa, sb]
+    elg = stack["make_elg"](1)
+    ups = Upstream("u")
+    for i, (srv, rule) in enumerate([
+            (sa, HintRule(host="a.example.com")),
+            (sb, HintRule(host="b.w.example.com"))]):
+        g = ServerGroup(f"g{i}", elg, fast_hc())
+        stack["groups"].append(g)
+        g.add("s", "127.0.0.1", srv.port)
+        wait_healthy(g, 1)
+        ups.add(g, annotations=rule)
+    cks = [CertKey("a", *certs["a"]), CertKey("w", *certs["w"])]
+    lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               cert_keys=cks)
+    stack["lbs"].append(lb)
+    lb.start()
+
+    def probe(sni):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        raw = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+        c = ctx.wrap_socket(raw, server_hostname=sni)
+        c.settimeout(5)
+        c.sendall(b"x")  # first data triggers backend selection
+        sid = c.recv(10)
+        c.close()
+        return sid
+
+    assert probe("a.example.com").startswith(b"RA")
+    assert probe("b.w.example.com").startswith(b"RB")
+
+
+def test_tls_command_grammar(stack, certs, tmp_path):
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+    from vproxy_tpu.control import persist
+
+    app = Application.create(workers=1)
+    try:
+        sa = IdServer("CA", http=True)
+        stack["servers"].append(sa)
+        cert, key = certs["a"]
+        Command.execute(app, f"add cert-key ck0 cert {cert} key {key}")
+        assert Command.execute(app, "list cert-key") == ["ck0"]
+        Command.execute(app, "add upstream u0")
+        Command.execute(app, "add server-group g0 timeout 500 period 100 up 1 down 1")
+        Command.execute(app, f"add server s0 to server-group g0 address 127.0.0.1:{sa.port}")
+        Command.execute(app, "add server-group g0 to upstream u0")
+        Command.execute(app, "add tcp-lb lb0 address 127.0.0.1:0 upstream u0 "
+                             "protocol http cert-key ck0")
+        wait_healthy(app.server_groups["g0"], 1)
+        body, _ = _tls_get(app.tcp_lbs["lb0"].bind_port, "a.example.com", "x")
+        assert body == b"CA"
+        cfg = persist.current_config(app)
+        assert f"add cert-key ck0 cert {cert} key {key}" in cfg
+        assert "cert-key ck0" in [ln for ln in cfg.splitlines()
+                                  if ln.startswith("add tcp-lb")][0]
+    finally:
+        app.close()

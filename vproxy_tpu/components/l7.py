@@ -37,7 +37,10 @@ class _Sel:
 
 class L7Engine(ProcessorEngine):
     def __init__(self, lb, loop, cfd: int, ip: str, port: int,
-                 processor: Processor):
+                 processor, front=None):
+        """processor: a Processor, or a session factory
+        callable(engine, addr) -> ProtoSession. front: a pre-built
+        Connection-like (e.g. TlsSocket); when None, cfd is wrapped."""
         self.lb = lb
         self.loop = loop
         self.client_ip = parse_ip(ip)
@@ -48,16 +51,21 @@ class L7Engine(ProcessorEngine):
         self._front_paused = False
         self._back_paused: set[int] = set()
         lb.active_sessions += 1
-        try:
-            self.front = Connection(loop, cfd, (ip, port))
-        except BaseException:
-            lb.active_sessions -= 1
-            from ..net import vtl
-            vtl.close(cfd)
-            raise
+        if front is not None:
+            self.front = front
+        else:
+            try:
+                self.front = Connection(loop, cfd, (ip, port))
+            except BaseException:
+                lb.active_sessions -= 1
+                from ..net import vtl
+                vtl.close(cfd)
+                raise
         self.front.set_handler(_FrontHandler(self))
+        make = processor.session if isinstance(processor, Processor) \
+            else processor
         try:
-            self.session = processor.session(self, (ip, port))
+            self.session = make(self, (ip, port))
         except Exception:
             self.close()
             raise

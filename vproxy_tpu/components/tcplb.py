@@ -35,10 +35,18 @@ class TcpLB:
                  worker: EventLoopGroup, bind_ip: str, bind_port: int,
                  backend: Upstream, protocol: str = "tcp",
                  security_group: Optional[SecurityGroup] = None,
-                 in_buffer_size: int = 65536, timeout_ms: int = 900_000):
+                 in_buffer_size: int = 65536, timeout_ms: int = 900_000,
+                 cert_keys: Optional[list] = None):
         if protocol not in ("tcp", "http-splice") \
                 and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
+        self.holder = None
+        self.cert_keys = cert_keys or []
+        if cert_keys:
+            from .certkey import CertKeyHolder
+            proc = processors.get(protocol)
+            alpn = list(proc.alpn) if proc is not None and proc.alpn else None
+            self.holder = CertKeyHolder(cert_keys, alpn=alpn)
         self.alias = alias
         self.acceptor = acceptor
         self.worker = worker
@@ -109,7 +117,9 @@ class TcpLB:
             self._serve(loop, cfd, ip, port)
 
     def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
-        if self.protocol == "tcp":
+        if self.holder is not None:
+            self._serve_tls(loop, cfd, ip, port)
+        elif self.protocol == "tcp":
             conn = self.backend.next(parse_ip(ip))
             if conn is None:
                 vtl.close(cfd)
@@ -119,6 +129,26 @@ class TcpLB:
             self._http_classify(loop, cfd, ip, port)
         else:
             L7Engine(self, loop, cfd, ip, port, processors.get(self.protocol))
+
+    def _serve_tls(self, loop, cfd: int, ip: str, port: int) -> None:
+        """TLS termination: decrypted bytes run through the L7 engine (the
+        native splice pump cannot cross python-resident TLS state). For
+        protocol=tcp the SNI becomes the classify hint
+        (SSLUnwrapRingBuffer.java:174-186 -> SSLContextHolder.choose)."""
+        from ..net.tls import TlsSocket
+        from ..processors.base import TcpRelaySession
+        from ..rules.ir import Hint
+        conn = Connection(loop, cfd, (ip, port))
+        tls = TlsSocket(conn, self.holder.front_context)
+        if self.protocol == "tcp":
+            def factory(eng, addr):
+                return TcpRelaySession(
+                    eng, addr,
+                    hint_fn=lambda: Hint.of_host(tls.sni) if tls.sni else None)
+        else:
+            name = "http1" if self.protocol == "http-splice" else self.protocol
+            factory = processors.get(name)
+        L7Engine(self, loop, cfd, ip, port, factory, front=tls)
 
     # ------------------------------------------------------ idle timeout
 

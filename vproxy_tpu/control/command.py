@@ -65,6 +65,7 @@ PARAM_KEYS = {
     "protocol": "protocol",
     "security-group": "secg", "secg": "secg",
     "cert-key": "ck", "ck": "ck",
+    "cert": "cert", "key": "key",
     "ttl": "ttl", "timeout": "timeout", "period": "period",
     "up": "up", "down": "down", "method": "method",
     "weight": "weight", "w": "weight",
@@ -449,6 +450,35 @@ def _h_secgr(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for security-group-rule")
 
 
+def _h_ck(app: Application, c: Command):
+    from ..components.certkey import CertKey
+    if c.action == "add":
+        if c.alias in app.cert_keys:
+            raise CmdError(f"cert-key {c.alias} already exists")
+        if "cert" not in c.params or "key" not in c.params:
+            raise CmdError("cert-key requires `cert <pem>` and `key <pem>`")
+        try:
+            app.cert_keys[c.alias] = CertKey(c.alias, c.params["cert"],
+                                             c.params["key"])
+        except (OSError, ValueError) as e:
+            raise CmdError(f"cannot load cert-key: {e}")
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.cert_keys.keys())
+        return [f"{ck.alias} -> cert {ck.cert_path} key {ck.key_path} "
+                f"names {','.join(ck.dns_names)}"
+                for ck in app.cert_keys.values()]
+    if c.action in ("remove", "force-remove"):
+        ck = _need(app.cert_keys, c.alias, "cert-key")
+        users = [lb.alias for lb in app.tcp_lbs.values() if ck in lb.cert_keys]
+        if users and c.action == "remove":
+            raise CmdError(f"cert-key {c.alias} is in use by {users}")
+        del app.cert_keys[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for cert-key")
+
+
 def _h_tl(app: Application, c: Command):
     if c.action == "add":
         if c.alias in app.tcp_lbs:
@@ -458,10 +488,15 @@ def _h_tl(app: Application, c: Command):
         aelg = _opt_elg(app, c, "aelg", app.acceptor_elg)
         elg = _opt_elg(app, c, "elg", app.worker_elg)
         secg = _opt_secg(app, c)
+        cks = None
+        if "ck" in c.params:
+            cks = [_need(app.cert_keys, a, "cert-key")
+                   for a in c.params["ck"].split(",")]
         lb = TcpLB(c.alias, aelg, elg, ip, port, ups,
                    protocol=c.params.get("protocol", "tcp"),
                    security_group=secg,
-                   in_buffer_size=int(c.params.get("in-buffer-size", 16384)))
+                   in_buffer_size=int(c.params.get("in-buffer-size", 16384)),
+                   cert_keys=cks)
         lb.start()
         app.tcp_lbs[c.alias] = lb
         return "OK"
@@ -603,6 +638,7 @@ _HANDLERS = {
     "server": _h_svr,
     "security-group": _h_secg,
     "security-group-rule": _h_secgr,
+    "cert-key": _h_ck,
     "tcp-lb": _h_tl,
     "socks5-server": _h_socks5,
     "dns-server": _h_dns,

@@ -104,6 +104,42 @@ class Processor:
         raise NotImplementedError
 
 
+class TcpRelaySession(ProtoSession):
+    """Raw bidirectional relay through one backend — the handleDirect
+    analog for fronts that cannot use the native splice pump (e.g. a
+    TLS-terminated frontend, Proxy.java:65-149 with SSL buffers). The
+    backend is selected on first data via hint_fn (SNI flows in here)."""
+
+    def __init__(self, engine: ProcessorEngine, client_addr, hint_fn=None):
+        self.engine = engine
+        self.hint_fn = hint_fn
+        self.back: Optional[int] = None
+
+    def _ensure(self) -> Optional[int]:
+        if self.back is None:
+            hint = self.hint_fn() if self.hint_fn is not None else None
+            try:
+                self.back = self.engine.open(self.engine.select(hint))
+            except OSError:
+                self.engine.close()
+                return None
+        return self.back
+
+    def on_front_data(self, data: bytes) -> None:
+        back = self._ensure()
+        if back is not None:
+            self.engine.send_back(back, data)
+
+    def on_back_data(self, conn_id: int, data: bytes) -> None:
+        self.engine.send_front(data)
+
+    def on_back_eof(self, conn_id: int) -> None:
+        self.engine.close()
+
+    def on_back_closed(self, conn_id: int, err: int) -> bool:
+        return False
+
+
 _REGISTRY: dict[str, Processor] = {}
 
 
