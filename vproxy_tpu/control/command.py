@@ -26,7 +26,7 @@ from ..components.upstream import Upstream
 from ..components.elgroup import EventLoopGroup
 from ..dns.server import DNSServer
 from ..rules.ir import AclRule, HintRule, Proto
-from ..utils.ip import Network
+from ..utils.ip import Network, format_ip
 from .app import (Application, DEFAULT_ACCEPTOR_ELG, DEFAULT_WORKER_ELG)
 
 ACTIONS = {"add": "add", "a": "add", "list": "list", "l": "list",
@@ -47,6 +47,14 @@ TYPES = {
     "security-group-rule": "security-group-rule", "secgr": "security-group-rule",
     "cert-key": "cert-key", "ck": "cert-key",
     "switch": "switch", "sw": "switch",
+    "vpc": "vpc",
+    "iface": "iface",
+    "route": "route",
+    "arp": "arp",
+    "user": "user",
+    "user-client": "user-client", "ucli": "user-client",
+    "tap": "tap",
+    "ip": "ip",
     "server-sock": "server-sock", "ss": "server-sock",
     "connection": "connection", "conn": "connection",
     "session": "session", "sess": "session",
@@ -72,6 +80,11 @@ PARAM_KEYS = {
     "annotations": "annotations", "default": "default",
     "network": "network", "net": "network",
     "port-range": "port-range",
+    "vni": "vni", "v4network": "v4network", "v6network": "v6network",
+    "password": "password", "pass": "password",
+    "via": "via", "mac": "mac",
+    "mac-table-timeout": "mac-table-timeout",
+    "arp-table-timeout": "arp-table-timeout",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -594,6 +607,265 @@ def _h_dns(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for dns-server")
 
 
+# ------------------------------------------------------------- vswitch
+
+def _ctx_switch(app: Application, c: Command):
+    chain = ([c.target] if c.target else []) + c.contexts
+    for kind, alias in chain:
+        if kind == "switch":
+            return _need(app.switches, alias, "switch")
+    raise CmdError(f"{c.type} requires `in/to switch <name>`")
+
+
+def _ctx_vpc(app: Application, c: Command):
+    """Resolve `... in vpc <vni> in switch <sw>` chains."""
+    sw = _ctx_switch(app, c)
+    chain = ([c.target] if c.target else []) + c.contexts
+    for kind, alias in chain:
+        if kind == "vpc":
+            try:
+                vni = int(alias)
+            except ValueError:
+                raise CmdError(f"bad vni {alias!r}")
+            if vni not in sw.networks:
+                raise CmdError(f"vpc {vni} not found in switch {sw.alias}")
+            return sw, sw.networks[vni]
+    raise CmdError(f"{c.type} requires `in vpc <vni> in switch <name>`")
+
+
+def _h_switch(app: Application, c: Command):
+    from ..vswitch.switch import Switch
+    if c.action == "add" and c.target is not None:
+        # remote switch link: add switch sw1 to switch sw0 address ip:port
+        sw = _ctx_switch(app, c)
+        ip, port = _addr(c.params["address"])
+        sw.add_remote_switch(c.alias, ip, port)
+        return "OK"
+    if c.action == "add":
+        if c.alias in app.switches:
+            raise CmdError(f"switch {c.alias} already exists")
+        ip, port = _addr(c.params["address"])
+        elg = _opt_elg(app, c, "elg", app.worker_elg)
+        secg = _opt_secg(app, c)
+        sw = Switch(c.alias, elg.next(), ip, port,
+                    mac_table_timeout_ms=int(c.params.get("mac-table-timeout",
+                                                          300_000)),
+                    arp_table_timeout_ms=int(c.params.get("arp-table-timeout",
+                                                          4 * 3600_000)),
+                    bare_vxlan_access=secg)
+        sw.start()
+        app.switches[c.alias] = sw
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(app.switches.keys())
+        return [f"{s.alias} -> bind {s.bind_ip}:{s.bind_port} "
+                f"mac-table-timeout {s.mac_table_timeout_ms} "
+                f"arp-table-timeout {s.arp_table_timeout_ms} "
+                f"bare-vxlan-access {s.bare_access.alias}"
+                for s in app.switches.values()]
+    if c.action in ("remove", "force-remove"):
+        if c.target is not None:
+            sw = _ctx_switch(app, c)
+            try:
+                sw.remove_iface(f"remote:{c.alias}")
+            except KeyError:
+                raise CmdError(f"remote switch {c.alias!r} not found")
+            return "OK"
+        sw = _need(app.switches, c.alias, "switch")
+        sw.stop()
+        del app.switches[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for switch")
+
+
+def _h_vpc(app: Application, c: Command):
+    sw = _ctx_switch(app, c)
+    if c.action == "add":
+        try:
+            vni = int(c.alias)
+        except ValueError:
+            raise CmdError(f"bad vni {c.alias!r}")
+        if "v4network" not in c.params:
+            raise CmdError("vpc requires v4network")
+        v6 = Network.parse(c.params["v6network"]) if "v6network" in c.params else None
+        try:
+            sw.add_network(vni, Network.parse(c.params["v4network"]), v6)
+        except ValueError as e:
+            raise CmdError(str(e))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return [str(v) for v in sw.networks]
+        return [f"{n.vni} -> v4network {n.v4net}"
+                + (f" v6network {n.v6net}" if n.v6net else "")
+                for n in sw.networks.values()]
+    if c.action in ("remove", "force-remove"):
+        try:
+            sw.del_network(int(c.alias))
+        except (KeyError, ValueError):
+            raise CmdError(f"vpc {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for vpc")
+
+
+def _h_iface(app: Application, c: Command):
+    sw = _ctx_switch(app, c)
+    if c.action in ("list", "list-detail"):
+        return [i.name for i in sw.list_ifaces()]
+    if c.action in ("remove", "force-remove"):
+        try:
+            sw.remove_iface(c.alias)
+        except KeyError:
+            raise CmdError(f"iface {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for iface")
+
+
+def _h_route(app: Application, c: Command):
+    from ..rules.ir import RouteRule
+    sw, net = _ctx_vpc(app, c)
+    if c.action == "add":
+        network = Network.parse(c.params["network"])
+        if "vni" in c.params:
+            rule = RouteRule(c.alias, network, to_vni=int(c.params["vni"]))
+        elif "via" in c.params:
+            rule = RouteRule(c.alias, network,
+                             via_ip=_parse_ip_str(c.params["via"]))
+        else:
+            raise CmdError("route requires `vni <n>` or `via <ip>`")
+        try:
+            net.add_route(rule)
+        except ValueError as e:
+            raise CmdError(str(e))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return [r.alias for r in net.routes.rules]
+        out = []
+        for r in net.routes.rules:
+            tgt = f"vni {r.to_vni}" if r.to_vni else \
+                f"via {format_ip(r.via_ip)}"
+            out.append(f"{r.alias} -> network {r.rule} {tgt}")
+        return out
+    if c.action in ("remove", "force-remove"):
+        try:
+            net.remove_route(c.alias)
+        except KeyError:
+            raise CmdError(f"route {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for route")
+
+
+def _h_arp(app: Application, c: Command):
+    from ..vswitch.packets import parse_mac
+    sw, net = _ctx_vpc(app, c)
+    if c.action == "add":
+        # alias is the mac; `ip` given via address param? use network-less ip
+        if "address" not in c.params:
+            raise CmdError("arp add requires `address <ip>`")
+        net.arps.record(_parse_ip_str(c.params["address"]),
+                        parse_mac(c.alias))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        macs = {m: getattr(i, "name", "?") for m, i in net.macs.entries()}
+        out = []
+        for ip_s, mac_s in net.arps.entries():
+            out.append(f"{mac_s} -> ip {ip_s} iface {macs.get(mac_s, '?')}")
+        return out
+    raise CmdError(f"unsupported action {c.action} for arp")
+
+
+def _h_user(app: Application, c: Command):
+    sw = _ctx_switch(app, c)
+    if c.action == "add":
+        if "password" not in c.params or "vni" not in c.params:
+            raise CmdError("user requires `password <p>` and `vni <n>`")
+        try:
+            sw.add_user(c.alias, c.params["password"], int(c.params["vni"]))
+        except ValueError as e:
+            raise CmdError(str(e))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        if c.action == "list":
+            return list(sw.users.keys())
+        return [f"{u} -> vni {vni}" for u, (_, vni, _pw) in sw.users.items()]
+    if c.action in ("remove", "force-remove"):
+        try:
+            sw.del_user(c.alias)
+        except KeyError:
+            raise CmdError(f"user {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for user")
+
+
+def _h_ucli(app: Application, c: Command):
+    sw = _ctx_switch(app, c)
+    if c.action == "add":
+        for k in ("password", "vni", "address"):
+            if k not in c.params:
+                raise CmdError(f"user-client requires `{k}`")
+        ip, port = _addr(c.params["address"])
+        sw.add_user_client(c.alias, c.params["password"],
+                           int(c.params["vni"]), ip, port)
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        return [i.name for i in sw.list_ifaces() if i.name.startswith("ucli:")]
+    if c.action in ("remove", "force-remove"):
+        try:
+            sw.remove_iface(f"ucli:{c.alias}")
+        except KeyError:
+            raise CmdError(f"user-client {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for user-client")
+
+
+def _h_tap(app: Application, c: Command):
+    sw = _ctx_switch(app, c)
+    if c.action == "add":
+        if "vni" not in c.params:
+            raise CmdError("tap requires `vni <n>`")
+        try:
+            iface = sw.add_tap(c.alias, int(c.params["vni"]))
+        except OSError as e:
+            raise CmdError(str(e))
+        return iface.dev
+    if c.action in ("list", "list-detail"):
+        return [i.name for i in sw.list_ifaces() if i.name.startswith("tap:")]
+    if c.action in ("remove", "force-remove"):
+        try:
+            sw.remove_iface(f"tap:{c.alias}")
+        except KeyError:
+            raise CmdError(f"tap {c.alias!r} not found")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for tap")
+
+
+def _h_ip(app: Application, c: Command):
+    from ..vswitch.switch import synthetic_mac
+    from ..vswitch.packets import mac_str
+    sw, net = _ctx_vpc(app, c)
+    if c.action == "add":
+        ip = _parse_ip_str(c.alias)
+        net.ips.add(ip, synthetic_mac(net.vni, ip))
+        return "OK"
+    if c.action in ("list", "list-detail"):
+        return [f"{format_ip(ip)} -> mac {mac_str(mac)}"
+                for ip, mac in net.ips.ips().items()]
+    if c.action in ("remove", "force-remove"):
+        net.ips.remove(_parse_ip_str(c.alias))
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for ip")
+
+
+def _parse_ip_str(s: str) -> bytes:
+    from ..utils.ip import parse_ip as _p
+    try:
+        return _p(s)
+    except (OSError, ValueError):
+        raise CmdError(f"bad ip {s!r}")
+
+
 def _all_lbs(app: Application) -> dict:
     out: dict = {}
     out.update(app.tcp_lbs)
@@ -639,6 +911,15 @@ _HANDLERS = {
     "security-group": _h_secg,
     "security-group-rule": _h_secgr,
     "cert-key": _h_ck,
+    "switch": _h_switch,
+    "vpc": _h_vpc,
+    "iface": _h_iface,
+    "route": _h_route,
+    "arp": _h_arp,
+    "user": _h_user,
+    "user-client": _h_ucli,
+    "tap": _h_tap,
+    "ip": _h_ip,
     "tcp-lb": _h_tl,
     "socks5-server": _h_socks5,
     "dns-server": _h_dns,

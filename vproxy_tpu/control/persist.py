@@ -81,6 +81,34 @@ def current_config(app: Application) -> str:
                      else f" security-group {d.security_group.alias}")
         lines.append(f"add dns-server {d.alias} address {d.bind_ip}:{d.bind_port} "
                      f"upstream {d.rrsets.alias} ttl {d.ttl}{secg_part}")
+    for sw in app.switches.values():
+        secg_part = ("" if sw.bare_access.alias == "(allow-all)"
+                     else f" security-group {sw.bare_access.alias}")
+        lines.append(
+            f"add switch {sw.alias} address {sw.bind_ip}:{sw.bind_port} "
+            f"mac-table-timeout {sw.mac_table_timeout_ms} "
+            f"arp-table-timeout {sw.arp_table_timeout_ms}{secg_part}")
+        for net in sw.networks.values():
+            v6 = f" v6network {net.v6net}" if net.v6net else ""
+            lines.append(f"add vpc {net.vni} to switch {sw.alias} "
+                         f"v4network {net.v4net}{v6}")
+            from ..utils.ip import format_ip
+            for ip in net.ips.ips():
+                lines.append(f"add ip {format_ip(ip)} to vpc {net.vni} "
+                             f"in switch {sw.alias}")
+            for r in net.routes.rules:
+                tgt = f"vni {r.to_vni}" if r.to_vni else \
+                    f"via {format_ip(r.via_ip)}"
+                lines.append(f"add route {r.alias} to vpc {net.vni} "
+                             f"in switch {sw.alias} network {r.rule} {tgt}")
+        for user, (_key, vni, password) in sw.users.items():
+            lines.append(f"add user {user} to switch {sw.alias} "
+                         f"password {password} vni {vni}")
+        for iface in sw.list_ifaces():
+            if iface.name.startswith("remote:"):
+                lines.append(
+                    f"add switch {iface.alias} to switch {sw.alias} "
+                    f"address {iface.remote[0]}:{iface.remote[1]}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
