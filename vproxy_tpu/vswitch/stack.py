@@ -65,6 +65,12 @@ class NetworkStack:
         if _is_multicast(ether.dst):
             self._flood(net, pkt, None)
             return
+        if net.ips.find_by_mac(ether.dst) is not None:
+            # switch-owned destination (e.g. two user-space TCP endpoints
+            # inside the same VPC): loop back into L3 on the next tick to
+            # keep the stack re-entrancy-free
+            self.sw.loop.next_tick(lambda: self.l3_input(net, ether, None))
+            return
         out = net.macs.lookup(ether.dst)
         if out is not None:
             out.send_vxlan(self.sw, pkt)
