@@ -1,0 +1,190 @@
+"""lib tests: vserver routing, vclient HTTP + SOCKS5, conn transfer
+(TestHttpServer / TestNetServerClient / TestConnTransfer analogs)."""
+import socket
+import threading
+import time
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.lib.transfer import ConnRef, ConnRefPool
+from vproxy_tpu.lib.vclient import HttpClient, SocksClient
+from vproxy_tpu.lib.vserver import HttpServer
+from vproxy_tpu.net.connection import Connection, Handler
+
+
+@pytest.fixture
+def loop():
+    elg = EventLoopGroup("lib", 1)
+    yield elg.next()
+    elg.close()
+
+
+def _wait(box, key, timeout=5.0):
+    t0 = time.time()
+    while key not in box:
+        if time.time() - t0 > timeout:
+            raise TimeoutError(box)
+        time.sleep(0.01)
+    return box[key]
+
+
+def test_vserver_routing_and_params(loop):
+    srv = HttpServer(loop)
+    srv.get("/hello", lambda r: r.resp.end("world"))
+    srv.get("/users/:id/posts/:pid",
+            lambda r: r.resp.end({"u": r.req.params["id"],
+                                  "p": r.req.params["pid"]}))
+    srv.post("/echo", lambda r: r.resp.end(r.req.body))
+    srv.get("/q", lambda r: r.resp.end(r.req.query.get("x", "")))
+    srv.all("/files/*", lambda r: r.resp.end(r.req.params["*"]))
+    srv.listen(0)
+
+    def http(req: bytes) -> bytes:
+        c = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        c.sendall(req)
+        data = b""
+        while True:
+            try:
+                d = c.recv(65536)
+            except socket.timeout:
+                break
+            if not d:
+                break
+            data += d
+        c.close()
+        return data
+
+    r = http(b"GET /hello HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    assert r.startswith(b"HTTP/1.1 200") and r.endswith(b"world")
+    r = http(b"GET /users/42/posts/7 HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    assert b'{"u": "42", "p": "7"}' in r
+    r = http(b"POST /echo HTTP/1.1\r\nhost: x\r\ncontent-length: 3\r\n"
+             b"connection: close\r\n\r\nabc")
+    assert r.endswith(b"abc")
+    r = http(b"GET /q?x=1&y=2 HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    assert r.endswith(b"1")
+    r = http(b"GET /files/a/b/c.txt HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    assert r.endswith(b"a/b/c.txt")
+    r = http(b"GET /nope HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    assert r.startswith(b"HTTP/1.1 404")
+    # keep-alive: two requests on one conn
+    c = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    for _ in range(2):
+        c.sendall(b"GET /hello HTTP/1.1\r\nhost: x\r\n\r\n")
+        data = b""
+        while b"world" not in data:
+            data += c.recv(65536)
+    c.close()
+    srv.close()
+
+
+def test_vclient_against_vserver(loop):
+    srv = HttpServer(loop)
+    srv.get("/ping", lambda r: r.resp.header("x-t", "1").end("pong"))
+    srv.post("/sum", lambda r: r.resp.end(str(sum(r.req.json()["ns"]))))
+    srv.listen(0)
+    cli = HttpClient(loop)
+    box = {}
+    cli.get("127.0.0.1", srv.port, "/ping",
+            lambda e, resp, conn: box.update(r1=(e, resp, conn)))
+    e, resp, conn = _wait(box, "r1")
+    assert e is None and resp.status == 200 and resp.body == b"pong"
+    assert resp.header("x-t") == "1"
+    # reuse the SAME connection for the next request (keep-alive)
+    cli.post("127.0.0.1", srv.port, "/sum", b'{"ns": [1, 2, 3]}',
+             lambda e2, r2, c2: box.update(r2=(e2, r2)), conn=conn)
+    e2, r2 = _wait(box, "r2")
+    assert e2 is None and r2.body == b"6"
+    srv.close()
+
+
+def test_socks_client_through_socks5_server(loop):
+    from vproxy_tpu.components.socks5 import Socks5Server
+    from vproxy_tpu.components.servergroup import ServerGroup, HealthCheckConfig
+    from vproxy_tpu.components.upstream import Upstream
+    from test_tcplb import IdServer, wait_healthy
+
+    backend = IdServer("SC")
+    elg = EventLoopGroup("s5", 1)
+    try:
+        g = ServerGroup("g", elg, HealthCheckConfig(500, 100, 1, 1))
+        g.add("b", "127.0.0.1", backend.port)
+        wait_healthy(g, 1)
+        ups = Upstream("u")
+        ups.add(g)
+        s5 = Socks5Server("s5", elg, elg, "127.0.0.1", 0, ups,
+                          allow_non_backend=True)
+        s5.start()
+
+        box = {}
+        sc = SocksClient(loop, "127.0.0.1", s5.bind_port)
+        sc.connect("127.0.0.1", backend.port,
+                   lambda e, ref: box.update(r=(e, ref)))
+        e, ref = _wait(box, "r")
+        assert e is None
+
+        got = {"data": b""}
+
+        class H(Handler):
+            def on_data(self, c, data):
+                got["data"] += data
+
+        def attach():
+            conn = ref.transfer(H())  # replays early backend bytes ("SC")
+            conn.write(b"hi")
+        loop.run_on_loop(attach)
+        t0 = time.time()
+        while b"SChi" not in got["data"] and time.time() - t0 < 5:
+            time.sleep(0.02)
+        assert got["data"] == b"SChi"  # id + echo
+        s5.stop()
+        g.close()
+    finally:
+        backend.close()
+        elg.close()
+
+
+def test_conn_transfer_and_pool(loop):
+    """An HTTP client conn is parked in a pool and later transferred to a
+    raw consumer (the WebSocks pattern: http conn -> raw proxied conn)."""
+    srv = HttpServer(loop)
+    srv.get("/x", lambda r: r.resp.end("ok"))
+    srv.listen(0)
+    cli = HttpClient(loop)
+    box = {}
+    cli.get("127.0.0.1", srv.port, "/x",
+            lambda e, resp, conn: box.update(r=(e, resp, conn)))
+    e, resp, conn = _wait(box, "r")
+    assert e is None and resp.body == b"ok"
+
+    pool = ConnRefPool(loop, capacity=4)
+    assert loop.call_sync(lambda: pool.put(conn)) is True
+    assert pool.count() == 1
+    got = loop.call_sync(pool.get)
+    assert got is conn and pool.count() == 0
+    # transferred conn still works as a raw keep-alive HTTP conn
+    cli.get("127.0.0.1", srv.port, "/x",
+            lambda e2, r2, c2: box.update(r2=(e2, r2)), conn=got)
+    e2, r2 = _wait(box, "r2")
+    assert e2 is None and r2.body == b"ok"
+    srv.close()
+
+
+def test_pool_drops_closed_idle_conns(loop):
+    srv = HttpServer(loop)
+    srv.get("/x", lambda r: r.resp.end("ok"))
+    srv.listen(0)
+    cli = HttpClient(loop)
+    box = {}
+    cli.get("127.0.0.1", srv.port, "/x",
+            lambda e, resp, conn: box.update(r=(e, resp, conn)))
+    _, _, conn = _wait(box, "r")
+    pool = ConnRefPool(loop, capacity=4)
+    loop.call_sync(lambda: pool.put(conn))
+    srv.close()  # server closes -> idle conn sees EOF -> dropped from pool
+    t0 = time.time()
+    while pool.count() and time.time() - t0 < 5:
+        time.sleep(0.02)
+    assert pool.count() == 0
+    assert loop.call_sync(pool.get) is None

@@ -2,17 +2,17 @@
 
 Parity: app controller/HttpController.java (routes :59-320, swagger
 doc/api.yaml): CRUD under /api/v1/module/<resource>, /healthz, plus a
-raw command endpoint. JSON bodies use the command grammar's param names;
-results of list endpoints are JSON arrays.
+raw command endpoint. Built on the embeddable vserver HTTP lib exactly
+as the reference's controller is built on its vserver. JSON bodies use
+the command grammar's param names; list endpoints return JSON arrays.
 """
 from __future__ import annotations
 
 import json
 from typing import Optional
 
-from ..net.connection import Connection, Handler, ServerSock
+from ..lib.vserver import HttpServer, RoutingContext
 from ..net.eventloop import SelectorEventLoop
-from ..processors.http1 import HeadParser
 from .app import Application
 from .command import CmdError, Command
 
@@ -22,84 +22,64 @@ MODULES = {
     "dns-server": "dns-server", "event-loop-group": "event-loop-group",
     "upstream": "upstream", "server-group": "server-group",
     "security-group": "security-group", "cert-key": "cert-key",
+    "switch": "switch",
 }
 FLAG_KEYS = {"allow-non-backend", "deny-non-backend"}
 
 
-def _resp(status: int, body, ctype: str = "application/json") -> bytes:
-    if isinstance(body, (dict, list)):
-        data = json.dumps(body).encode()
-    elif isinstance(body, str):
-        data = body.encode()
-    else:
-        data = body or b""
-    reason = {200: "OK", 204: "No Content", 400: "Bad Request",
-              404: "Not Found", 405: "Method Not Allowed",
-              500: "Internal Server Error"}.get(status, "OK")
-    head = (f"HTTP/1.1 {status} {reason}\r\ncontent-type: {ctype}\r\n"
-            f"content-length: {len(data)}\r\nconnection: close\r\n\r\n")
-    return head.encode() + data
+class HttpController:
+    def __init__(self, app: Application, bind_ip: str, bind_port: int,
+                 loop: Optional[SelectorEventLoop] = None):
+        self.app = app
+        self.loop = loop or app.control_loop
+        self.bind_ip, self.bind_port = bind_ip, bind_port
+        self._srv: Optional[HttpServer] = None
 
+    def start(self) -> None:
+        srv = HttpServer(self.loop)
+        srv.get("/healthz", lambda r: r.resp.end({"status": "ok"}))
+        srv.post("/api/v1/command", self._command)
+        srv.all("/api/v1/module/*", self._module)
+        srv.listen(self.bind_port, self.bind_ip)
+        self.bind_port = srv.port
+        self._srv = srv
 
-class _HttpConn(Handler):
-    def __init__(self, ctl: "HttpController", conn: Connection):
-        self.ctl = ctl
-        self.conn = conn
-        self.parser = HeadParser()
-        self.body = b""
-        self.handled = False
-        conn.set_handler(self)
+    def stop(self) -> None:
+        if self._srv is not None:
+            srv, self._srv = self._srv, None
+            srv.close()
 
-    def on_data(self, conn: Connection, data: bytes) -> None:
-        if self.handled:
-            # request already executed; the conn closes shortly — drop any
-            # pipelined bytes rather than re-running the command
-            return
-        if not self.parser.done:
-            self.parser.feed(data)
-            if self.parser.error:
-                conn.write(_resp(400, {"error": self.parser.error}))
-                self.ctl.loop.delay(50, conn.close)
-                return
-            if not self.parser.done:
-                return
-            self.body = bytes(self.parser.buf[self.parser.head_len:])
-        else:
-            self.body += data
-        cl = int(self.parser.header("content-length") or 0)
-        if len(self.body) < cl:
-            return
-        self.handled = True
-        status, payload = self._route(self.parser.method,
-                                      self.parser.uri, self.body[:cl])
-        conn.write(_resp(status, payload))
-        self.ctl.loop.delay(50, conn.close)
+    # ----------------------------------------------------------- handlers
 
-    def _route(self, method: str, uri: str, body: bytes):
-        app = self.ctl.app
-        path = uri.split("?")[0].rstrip("/")
+    def _command(self, r: RoutingContext) -> None:
         try:
-            if path == "/healthz":
-                return 200, {"status": "ok"}
-            if path == "/api/v1/command" and method == "POST":
-                cmd = json.loads(body or b"{}").get("command", "")
-                result = Command.execute(app, cmd)
-                return 200, {"result": result}
-            parts = [p for p in path.split("/") if p]
-            # /api/v1/module/<type>[/<name>]
-            if len(parts) >= 4 and parts[0] == "api" and parts[1] == "v1" \
-                    and parts[2] == "module" and parts[3] in MODULES:
-                rtype = MODULES[parts[3]]
-                name = parts[4] if len(parts) > 4 else None
-                sub = parts[5:] if len(parts) > 5 else []
-                return self._module(method, rtype, name, sub, body)
-            return 404, {"error": f"no such endpoint {path}"}
+            cmd = r.req.json().get("command", "")
+            r.resp.end({"result": Command.execute(self.app, cmd)})
         except CmdError as e:
-            return 400, {"error": str(e)}
+            r.resp.status(400).end({"error": str(e)})
         except json.JSONDecodeError as e:
-            return 400, {"error": f"bad json: {e}"}
+            r.resp.status(400).end({"error": f"bad json: {e}"})
         except Exception as e:
-            return 500, {"error": f"{type(e).__name__}: {e}"}
+            r.resp.status(500).end({"error": f"{type(e).__name__}: {e}"})
+
+    def _module(self, r: RoutingContext) -> None:
+        parts = [p for p in r.req.params.get("*", "").split("/") if p]
+        if not parts or parts[0] not in MODULES:
+            r.resp.status(404).end({"error": "no such module"})
+            return
+        rtype = MODULES[parts[0]]
+        name = parts[1] if len(parts) > 1 else None
+        sub = parts[2:] if len(parts) > 2 else []
+        try:
+            status, payload = self._dispatch(r.req.method, rtype, name, sub,
+                                             r.req.body)
+        except CmdError as e:
+            status, payload = 400, {"error": str(e)}
+        except json.JSONDecodeError as e:
+            status, payload = 400, {"error": f"bad json: {e}"}
+        except Exception as e:
+            status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+        r.resp.status(status).end(payload)
 
     @staticmethod
     def _cmdline(action: str, rtype: str, name: str, params: dict) -> str:
@@ -115,12 +95,11 @@ class _HttpConn(Handler):
                 toks += [k, str(v)]
         return " ".join(toks)
 
-    def _module(self, method: str, rtype: str, name, sub, body: bytes):
-        app = self.ctl.app
+    def _dispatch(self, method: str, rtype: str, name, sub, body: bytes):
+        app = self.app
         if method == "GET":
             if name is None:
                 return 200, Command.execute(app, f"list-detail {rtype}")
-            # sub-resource listing e.g. /server-group/sg0/server
             if sub:
                 return 200, Command.execute(
                     app, f"list-detail {sub[0]} in {rtype} {name}")
@@ -154,30 +133,6 @@ class _HttpConn(Handler):
             if sub:
                 return 200, {"result": Command.execute(
                     app, f"remove {sub[0]} {sub[1]} from {rtype} {name}")}
-            return 200, {"result": Command.execute(app, f"force-remove {rtype} {name}")}
+            return 200, {"result": Command.execute(app,
+                                                   f"force-remove {rtype} {name}")}
         return 405, {"error": f"method {method} not allowed"}
-
-
-class HttpController:
-    def __init__(self, app: Application, bind_ip: str, bind_port: int,
-                 loop: Optional[SelectorEventLoop] = None):
-        self.app = app
-        self.loop = loop or app.control_loop
-        self.bind_ip, self.bind_port = bind_ip, bind_port
-        self._srv: Optional[ServerSock] = None
-
-    def start(self) -> None:
-        def mk() -> None:
-            self._srv = ServerSock(self.loop, self.bind_ip, self.bind_port,
-                                   self._on_accept)
-            self.bind_port = self._srv.port
-        self.loop.call_sync(mk)
-
-    def _on_accept(self, fd: int, ip: str, port: int) -> None:
-        _HttpConn(self, Connection(self.loop, fd, (ip, port)))
-
-    def stop(self) -> None:
-        if self._srv is not None:
-            srv = self._srv
-            self._srv = None
-            self.loop.run_on_loop(srv.close)
