@@ -1,0 +1,73 @@
+"""Metrics registry + GlobalInspection HTTP surface.
+
+Reference analogs: prometheus/Metrics.java text exposition,
+GlobalInspection.java dumps, TestPrometheus.
+"""
+import socket
+import time
+
+from vproxy_tpu.net.eventloop import SelectorEventLoop
+from vproxy_tpu.utils.metrics import (Counter, Gauge, GaugeF, GlobalInspection,
+                                      MetricsRegistry, launch_inspection_http)
+
+
+def http_get(port, path):
+    s = socket.create_connection(("127.0.0.1", port), timeout=3)
+    s.sendall(b"GET %s HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+              % path.encode())
+    buf = b""
+    while True:
+        d = s.recv(65536)
+        if not d:
+            break
+        buf += d
+    s.close()
+    head, _, body = buf.partition(b"\r\n\r\n")
+    return int(head.split()[1]), body
+
+
+def test_registry_text_format():
+    r = MetricsRegistry()
+    c = r.counter("vproxy_requests_total", loop="w0")
+    c.incr(3)
+    g = r.gauge("vproxy_conns")
+    g.set(7)
+    r.gauge_f("vproxy_dyn", lambda: 1.5)
+    text = r.prometheus_text()
+    assert '# TYPE vproxy_requests_total counter' in text
+    assert 'vproxy_requests_total{loop="w0"} 3' in text
+    assert "vproxy_conns 7" in text
+    assert "vproxy_dyn 1.5" in text
+
+
+def test_global_inspection_http():
+    loop = SelectorEventLoop("gi")
+    loop.loop_thread()
+    time.sleep(0.05)  # loop registers itself on first spin
+    srv = launch_inspection_http(loop, "127.0.0.1", 0)
+    port = srv.port
+    try:
+        st, body = http_get(port, "/metrics")
+        assert st == 200
+        assert b"vproxy_event_loop_count" in body
+        assert b"vproxy_open_fd_count" in body
+        st, body = http_get(port, "/jstack")
+        assert st == 200 and b"Thread" in body
+        st, body = http_get(port, "/lsof")
+        assert st == 200 and body.strip()
+        st, body = http_get(port, "/healthz")
+        assert st == 200 and body == b"OK"
+    finally:
+        srv.close()
+        loop.close()
+
+
+def test_loop_registration_lifecycle():
+    gi = GlobalInspection.get()
+    before = len(gi._loops)
+    lp = SelectorEventLoop("gi2")
+    lp.loop_thread()
+    time.sleep(0.05)
+    assert len(gi._loops) == before + 1
+    lp.close()
+    assert len(gi._loops) == before

@@ -24,11 +24,20 @@ from .elgroup import EventLoopGroup
 
 @dataclass
 class HealthCheckConfig:
+    """check/HealthCheckConfig + the hc annotations of AnnotatedHcConfig
+    (ConnectClient.java:166-290): http checks GET a url and accept the
+    configured status classes (default 1xx-4xx), dns checks resolve a
+    domain against the backend as nameserver."""
     timeout_ms: int = 2000
     period_ms: int = 5000
     up: int = 2
     down: int = 3
-    protocol: str = "tcp"  # none | tcp | tcpDelay | http
+    protocol: str = "tcp"  # none | tcp | tcpDelay | dns | http
+    http_method: str = "GET"
+    http_url: str = "/"
+    http_host: Optional[str] = None
+    http_status: tuple = (1, 2, 3, 4)  # accepted status/100 classes
+    dns_domain: str = "example.com"
 
 
 @dataclass
@@ -43,6 +52,7 @@ class ServerHandle:
     bytes_out: int = 0
     logic_delete: bool = False
     host_name: Optional[str] = None
+    check_cost_ms: float = -1.0  # tcpDelay: last successful connect cost
     _up_cnt: int = 0
     _down_cnt: int = 0
 
@@ -79,12 +89,22 @@ class _HealthChecker:
         if self.stopped:
             return
         cfg = self.group.hc
+        if cfg.protocol == "http":
+            self._check_http(cfg)
+        elif cfg.protocol == "dns":
+            self._check_dns(cfg)
+        else:
+            self._check_tcp(cfg)
+
+    def _check_tcp(self, cfg: HealthCheckConfig) -> None:
+        import time as _time
         try:
             fd = vtl.tcp_connect(self.svr.ip, self.svr.port)
         except OSError:
             self._result(False)
             return
         state = {"done": False}
+        t0 = _time.monotonic()
 
         def finish(ok: bool) -> None:
             if state["done"]:
@@ -93,6 +113,8 @@ class _HealthChecker:
             if self.loop.registered(fd):
                 self.loop.remove(fd)
             vtl.close(fd)
+            if ok and cfg.protocol == "tcpDelay":
+                self.svr.check_cost_ms = (_time.monotonic() - t0) * 1000.0
             self._result(ok)
 
         def on_ev(_fd: int, ev: int) -> None:
@@ -100,6 +122,85 @@ class _HealthChecker:
 
         self.loop.add(fd, vtl.EV_WRITE, on_ev)
         self.loop.delay(cfg.timeout_ms, lambda: finish(False))
+
+    def _check_http(self, cfg: HealthCheckConfig) -> None:
+        """connect, send one request, parse the status line; up iff the
+        status class is in cfg.http_status (ConnectClient.java:166-215)."""
+        from ..net.connection import Connection, Handler
+
+        state = {"done": False, "buf": b"", "conn": None}
+
+        def finish(ok: bool) -> None:
+            if state["done"]:
+                return
+            state["done"] = True
+            if state["conn"] is not None:
+                state["conn"].close()
+            self._result(ok)
+
+        host = cfg.http_host or self.svr.host_name or self.svr.ip
+
+        class H(Handler):
+            def on_connected(_s, conn) -> None:
+                conn.write((f"{cfg.http_method} {cfg.http_url} HTTP/1.1\r\n"
+                            f"Host: {host}\r\nConnection: close\r\n\r\n"
+                            ).encode())
+
+            def on_data(_s, conn, data) -> None:
+                state["buf"] += data
+                if b"\r\n" not in state["buf"]:
+                    if len(state["buf"]) > 4096:
+                        finish(False)
+                    return
+                line = state["buf"].split(b"\r\n", 1)[0].split()
+                if len(line) < 2 or not line[0].startswith(b"HTTP/"):
+                    finish(False)
+                    return
+                try:
+                    status = int(line[1])
+                except ValueError:
+                    finish(False)
+                    return
+                finish(100 <= status < 600 and
+                       status // 100 in cfg.http_status)
+
+            def on_eof(_s, conn) -> None:
+                finish(False)
+
+            def on_closed(_s, conn, err) -> None:
+                finish(False)
+
+        def start() -> None:
+            try:
+                c = Connection.connect(self.loop, self.svr.ip, self.svr.port)
+            except OSError:
+                finish(False)
+                return
+            state["conn"] = c
+            c.set_handler(H())
+            self.loop.delay(cfg.timeout_ms, lambda: finish(False))
+        start()
+
+    def _check_dns(self, cfg: HealthCheckConfig) -> None:
+        """resolve cfg.dns_domain with the backend as the nameserver; up
+        iff a well-formed answer comes back (ConnectClient.java:286-290)."""
+        from ..dns import packet as P
+        from ..dns.client import DNSClient
+
+        state = {"done": False}
+        client = DNSClient(self.loop, [(self.svr.ip, self.svr.port)],
+                           timeout_ms=cfg.timeout_ms, max_retry=1)
+
+        def cb(resp, err) -> None:
+            if state["done"]:
+                return
+            state["done"] = True
+            # cb runs inside the client's recvfrom loop: closing the fd
+            # here would make that loop read a dead (or reused) fd
+            self.loop.next_tick(client.close)
+            self._result(err is None and resp is not None)
+
+        client.query(cfg.dns_domain, P.A, cb)
 
     def _result(self, ok: bool) -> None:
         if self.stopped:

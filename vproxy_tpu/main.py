@@ -40,7 +40,7 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     opts = {"resp": DEFAULT_RESP, "resp_pass": None, "http": DEFAULT_HTTP,
             "load": None, "no_load": False, "no_save": False,
-            "no_stdio": False, "workers": None}
+            "no_stdio": False, "workers": None, "inspect": None}
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -66,6 +66,9 @@ def main(argv: list[str] | None = None) -> int:
         elif a == "workers":
             opts["workers"] = int(argv[i + 1])
             i += 2
+        elif a == "globalInspection":
+            opts["inspect"] = _addr(argv[i + 1])
+            i += 2
         elif a in ("allowSystemCommandInNonStdIOController", "noStartupBindCheck"):
             i += 1
         elif a in ("version", "-version", "--version"):
@@ -88,6 +91,13 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"resp-controller on {opts['resp'][0]}:{resp.bind_port}")
     print(f"http-controller on {opts['http'][0]}:{http.bind_port}")
+
+    if opts["inspect"] is not None:
+        from .utils.metrics import launch_inspection_http
+        launch_inspection_http(app.control_loop, opts["inspect"][0],
+                               opts["inspect"][1])
+        print(f"global-inspection on {opts['inspect'][0]}:"
+              f"{opts['inspect'][1]}")
 
     if opts["load"]:
         n = persist.load(app, opts["load"])

@@ -260,6 +260,8 @@ class SelectorEventLoop:
 
     def loop(self) -> None:
         self._thread = threading.current_thread()
+        from ..utils.metrics import GlobalInspection
+        GlobalInspection.get().register_loop(self)
         while not self._closed:
             self.one_poll()
 
@@ -273,6 +275,8 @@ class SelectorEventLoop:
         if self._closed:
             return
         self._closed = True
+        from ..utils.metrics import GlobalInspection
+        GlobalInspection.get().deregister_loop(self)
         if self._thread is not None and self._thread is not threading.current_thread():
             vtl.LIB.vtl_wakeup(self._lp)
             self._thread.join(timeout=5)

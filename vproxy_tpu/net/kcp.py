@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import struct
 import threading
+from collections import deque
 import time
 from typing import Callable, List, Optional
 
@@ -84,10 +85,10 @@ class Kcp:
         self.cwnd = 0
         self.incr = 0
         self.ssthresh = 2
-        self.snd_queue: List[_Seg] = []
-        self.snd_buf: List[_Seg] = []
-        self.rcv_queue: List[_Seg] = []
-        self.rcv_buf: List[_Seg] = []
+        self.snd_queue: deque = deque()
+        self.snd_buf: deque = deque()
+        self.rcv_queue: deque = deque()
+        self.rcv_buf: List[_Seg] = []  # out-of-order window; stays small
         self.acklist: List[tuple] = []  # (sn, ts)
         self.rx_srtt = 0
         self.rx_rttval = 0
@@ -151,7 +152,7 @@ class Kcp:
         was_full = len(self.rcv_queue) >= self.rcv_wnd
         parts = []
         while self.rcv_queue:
-            seg = self.rcv_queue.pop(0)
+            seg = self.rcv_queue.popleft()
             parts.append(seg.data)
             if seg.frg == 0:
                 break
@@ -204,7 +205,10 @@ class Kcp:
                 pass
             else:
                 return
-        if maxack >= 0:
+        # only an in-window maxack may drive fast retransmit; an
+        # out-of-range ack sn would inflate fastack on every segment
+        if maxack >= 0 and _diff(maxack, self.snd_una) >= 0 and \
+                _diff(maxack, self.snd_nxt) < 0:
             for seg in self.snd_buf:
                 if _diff(seg.sn, maxack) < 0:
                     seg.fastack += 1
@@ -224,15 +228,15 @@ class Kcp:
 
     def _parse_una(self, una: int) -> None:
         while self.snd_buf and _diff(self.snd_buf[0].sn, una) < 0:
-            self.snd_buf.pop(0)
+            self.snd_buf.popleft()
         self._shrink_buf()
 
     def _parse_ack(self, sn: int) -> None:
         if _diff(sn, self.snd_una) < 0 or _diff(sn, self.snd_nxt) >= 0:
             return
-        for i, seg in enumerate(self.snd_buf):
+        for seg in self.snd_buf:
             if seg.sn == sn:
-                self.snd_buf.pop(i)
+                self.snd_buf.remove(seg)
                 break
             if _diff(sn, seg.sn) < 0:
                 break
@@ -339,7 +343,7 @@ class Kcp:
             cwnd = min(cwnd, max(1, self.cwnd))
         while self.snd_queue and \
                 _diff(self.snd_nxt, (self.snd_una + cwnd) & 0xFFFFFFFF) < 0:
-            seg = self.snd_queue.pop(0)
+            seg = self.snd_queue.popleft()
             seg.conv = self.conv
             seg.cmd = CMD_PUSH
             seg.sn = self.snd_nxt

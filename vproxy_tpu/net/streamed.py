@@ -22,6 +22,7 @@ Client streams use odd ids, server streams even — no id races.
 from __future__ import annotations
 
 import struct
+from collections import deque
 from typing import Callable, Dict, Optional
 
 from .eventloop import SelectorEventLoop
@@ -47,6 +48,10 @@ class StreamHandler:
 class Stream:
     """One virtual stream; Connection-flavored surface."""
 
+    # bytes buffered while no handler is attached (accept callback may
+    # defer set_handler); beyond this the stream is reset
+    PENDING_MAX = 1 << 20
+
     def __init__(self, sess: "StreamedSession", sid: int):
         self.sess = sess
         self.sid = sid
@@ -55,9 +60,14 @@ class Stream:
         self.eof_sent = False
         self.eof_rcvd = False
         self.closed = False
+        self._pending: deque = deque()
+        self._pending_bytes = 0
 
     def set_handler(self, h: StreamHandler) -> None:
         self.handler = h
+        while self._pending and not self.closed:
+            h.on_data(self, self._pending.popleft())
+        self._pending_bytes = 0
 
     # one PSH = one KCP message; keep well under KCP's fragment window
     # (255 frags / rcv_wnd) so any write size is legal
@@ -119,7 +129,8 @@ class StreamedSession(KcpHandler):
         self._ka = None
 
         def arm() -> None:
-            self._ka = loop.period(KEEPALIVE_MS, self._keepalive)
+            if not self.broken:  # close() may have raced the deferred arm
+                self._ka = loop.period(KEEPALIVE_MS, self._keepalive)
         loop.run_on_loop(arm)
         if is_client:
             self._send(0, F_HELLO)
@@ -175,8 +186,14 @@ class StreamedSession(KcpHandler):
             s = self.streams.get(sid)
             if s is None:
                 self._send(sid, F_RST)
-            elif s.handler is not None and not s.eof_rcvd:
-                s.handler.on_data(s, payload)
+            elif not s.eof_rcvd:
+                if s.handler is not None:
+                    s.handler.on_data(s, payload)
+                elif s._pending_bytes + len(payload) <= s.PENDING_MAX:
+                    s._pending.append(payload)
+                    s._pending_bytes += len(payload)
+                else:
+                    s.close()  # RSTs and dies rather than dropping bytes
         elif ftype == F_FIN:
             s = self.streams.get(sid)
             if s is not None and not s.eof_rcvd:
@@ -203,14 +220,14 @@ class StreamedSession(KcpHandler):
             return
         self._missed += 1
         if self._missed > KEEPALIVE_MISS:
-            self._break()
+            self._break(notify=True)
             return
         self._send(0, F_PING)
 
     def on_broken(self, conn: KcpConn) -> None:
-        self._break()
+        self._break(notify=True)
 
-    def _break(self) -> None:
+    def _break(self, notify: bool) -> None:
         if self.broken:
             return
         self.broken = True
@@ -219,8 +236,10 @@ class StreamedSession(KcpHandler):
         for s in list(self.streams.values()):
             s._die()
         self.kcp.close()
-        if self.on_broken_cb is not None:
+        if notify and self.on_broken_cb is not None:
             self.on_broken_cb()
 
     def close(self) -> None:
-        self._break()
+        """Deliberate local shutdown: does NOT fire on_broken (a caller
+        wiring on_broken to reconnect logic must not re-dial here)."""
+        self._break(notify=False)

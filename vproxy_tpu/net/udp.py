@@ -12,6 +12,7 @@ Connection ports over unchanged.
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Callable, Dict, Optional, Tuple
 
 from . import vtl
@@ -76,12 +77,16 @@ class UdpVirtualConn:
     are sendto() on the shared server socket.
     """
 
+    # datagrams buffered while no handler is attached; beyond this they
+    # are dropped (UDP semantics), bounding memory against floods
+    PENDING_MAX = 256
+
     def __init__(self, server: "UdpServer", ip: str, port: int):
         self.server = server
         self.remote = (ip, port)
         self.handler = None
         self.closed = False
-        self._pending: list[bytes] = []
+        self._pending: deque = deque()
         self._touch()
 
     def _touch(self) -> None:
@@ -90,13 +95,13 @@ class UdpVirtualConn:
     def set_handler(self, h) -> None:
         self.handler = h
         while self._pending and not self.closed:
-            data = self._pending.pop(0)
-            h.on_data(self, data)
+            h.on_data(self, self._pending.popleft())
 
     def _deliver(self, data: bytes) -> None:
         self._touch()
         if self.handler is None:
-            self._pending.append(data)
+            if len(self._pending) < self.PENDING_MAX:
+                self._pending.append(data)
         else:
             self.handler.on_data(self, data)
 
@@ -137,7 +142,8 @@ class UdpServer:
         self._sweeper = None
 
         def arm() -> None:
-            self._sweeper = loop.period(sweep, self._expire)
+            if not self.closed:  # close() may have raced the deferred arm
+                self._sweeper = loop.period(sweep, self._expire)
         loop.run_on_loop(arm)
 
     def _on_packet(self, data: bytes, ip: str, port: int) -> None:

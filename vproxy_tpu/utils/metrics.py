@@ -1,0 +1,214 @@
+"""Prometheus-style metrics registry + global inspection surface.
+
+Parity: reference `vproxybase/prometheus/Metrics.java` (Counter / Gauge
+/ GaugeF with a label set, text exposition) and `GlobalInspection.java:
+24-205`: one process-global surface collecting direct-memory bytes,
+per-loop thread registry, stack-trace dump and open-FD dump, exposed
+over HTTP (`getPrometheusString():177`,
+`GlobalInspectionHttpServerLauncher.java:9` — /metrics, /lsof, /jstack).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Metric:
+    mtype = "untyped"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        self.name = name
+        self.labels = dict(labels or {})
+
+    def value(self) -> float:
+        raise NotImplementedError
+
+    def sample_line(self) -> str:
+        v = self.value()
+        v_str = "%d" % v if float(v).is_integer() else repr(float(v))
+        return f"{self.name}{_fmt_labels(self.labels)} {v_str}"
+
+
+class Counter(Metric):
+    mtype = "counter"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, labels)
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def incr(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def value(self) -> float:
+        return self._v
+
+
+class Gauge(Metric):
+    mtype = "gauge"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, labels)
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    def add(self, d: float) -> None:
+        self._v += d
+
+    def value(self) -> float:
+        return self._v
+
+
+class GaugeF(Metric):
+    """Gauge computed by a function at scrape time."""
+    mtype = "gauge"
+
+    def __init__(self, name: str, fn: Callable[[], float],
+                 labels: Optional[Dict[str, str]] = None):
+        super().__init__(name, labels)
+        self.fn = fn
+
+    def value(self) -> float:
+        return float(self.fn())
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: List[Metric] = []
+        self._lock = threading.Lock()
+
+    def add(self, m: Metric) -> Metric:
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def remove(self, m: Metric) -> None:
+        with self._lock:
+            if m in self._metrics:
+                self._metrics.remove(m)
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self.add(Counter(name, labels))  # type: ignore[return-value]
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self.add(Gauge(name, labels))  # type: ignore[return-value]
+
+    def gauge_f(self, name: str, fn, **labels) -> GaugeF:
+        return self.add(GaugeF(name, fn, labels))  # type: ignore[return-value]
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        by_name: Dict[str, Tuple[str, List[Metric]]] = {}
+        for m in metrics:
+            by_name.setdefault(m.name, (m.mtype, []))[1].append(m)
+        out = []
+        for name in sorted(by_name):
+            mtype, ms = by_name[name]
+            out.append(f"# TYPE {name} {mtype}")
+            out.extend(m.sample_line() for m in ms)
+        return "\n".join(out) + ("\n" if out else "")
+
+
+class GlobalInspection:
+    """Process-global metric + introspection surface (singleton)."""
+
+    _instance: Optional["GlobalInspection"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self._loops: Dict[int, object] = {}  # id(loop) -> SelectorEventLoop
+        self._lock = threading.Lock()
+        self.direct_memory_bytes = self.registry.gauge(
+            "vproxy_direct_memory_bytes_current")
+        self.registry.gauge_f("vproxy_event_loop_count",
+                              lambda: len(self._loops))
+        self.registry.gauge_f("vproxy_open_fd_count",
+                              lambda: len(self._open_fds()))
+        self.registry.gauge_f("vproxy_thread_count",
+                              lambda: threading.active_count())
+
+    @classmethod
+    def get(cls) -> "GlobalInspection":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = GlobalInspection()
+            return cls._instance
+
+    # ----------------------------------------------------------- loops
+
+    def register_loop(self, loop) -> None:
+        with self._lock:
+            self._loops[id(loop)] = loop
+
+    def deregister_loop(self, loop) -> None:
+        with self._lock:
+            self._loops.pop(id(loop), None)
+
+    # ------------------------------------------------------------ dumps
+
+    @staticmethod
+    def _open_fds() -> List[str]:
+        try:
+            return sorted(os.listdir("/proc/self/fd"), key=int)
+        except OSError:
+            return []
+
+    def open_fd_dump(self) -> str:
+        """lsof analog: fd -> target (GlobalInspection.java:196-205)."""
+        lines = []
+        for fd in self._open_fds():
+            try:
+                target = os.readlink(f"/proc/self/fd/{fd}")
+            except OSError:
+                target = "?"
+            lines.append(f"{fd}\t{target}")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def stack_trace_dump() -> str:
+        """jstack analog (GlobalInspection.java:181-194)."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append(f'Thread "{names.get(tid, "?")}" id={tid}')
+            out.extend(l.rstrip() for l in traceback.format_stack(frame))
+            out.append("")
+        return "\n".join(out)
+
+    def prometheus_string(self) -> str:
+        return self.registry.prometheus_text()
+
+
+def launch_inspection_http(loop, ip: str, port: int):
+    """Serve /metrics, /lsof, /jstack, /healthz — the reference's
+    `-Dglobal_inspection=host:port` server (Main.java:85-104). Returns
+    the HttpServer (close() to stop)."""
+    from ..lib.vserver import HttpServer
+
+    gi = GlobalInspection.get()
+    srv = HttpServer(loop)
+    srv.get("/metrics", lambda ctx: ctx.resp
+            .header("Content-Type", "text/plain; version=0.0.4")
+            .end(gi.prometheus_string()))
+    srv.get("/lsof", lambda ctx: ctx.resp
+            .header("Content-Type", "text/plain").end(gi.open_fd_dump()))
+    srv.get("/jstack", lambda ctx: ctx.resp
+            .header("Content-Type", "text/plain").end(gi.stack_trace_dump()))
+    srv.get("/healthz", lambda ctx: ctx.resp.end(b"OK"))
+    srv.listen(port, ip)
+    return srv
