@@ -1,0 +1,2 @@
+"""Deployable apps (reference vproxyx/*): Simple one-liner LB,
+HelloWorld smoke test, Daemon supervisor, KcpTun tunnel."""

@@ -108,7 +108,8 @@ class ConnectionPool:
         def run() -> None:
             if self._ka is not None:
                 self._ka.cancel()
-            for c in self._idle:
+            # copy: conn.close() reenters _on_dead which mutates _idle
+            for c in list(self._idle):
                 c.close()
             self._idle.clear()
         self.loop.run_on_loop(run)

@@ -269,7 +269,7 @@ class ServerGroup:
             s = ServerHandle(name=name, ip=ip, port=port, weight=weight)
             self.servers.append(s)
             self._recalc()
-        self._checkers[name] = _HealthChecker(self.elg.next(), self, s)
+            self._checkers[name] = _HealthChecker(self.elg.next(), self, s)
         return s
 
     def remove(self, name: str) -> None:
@@ -278,12 +278,34 @@ class ServerGroup:
                 if s.name == name:
                     del self.servers[i]
                     self._recalc()
-                    break
-            else:
-                raise KeyError(name)
-        chk = self._checkers.pop(name, None)
-        if chk:
-            chk.stop()
+                    chk = self._checkers.pop(name, None)
+                    if chk:
+                        chk.stop()
+                    return
+            raise KeyError(name)
+
+    def replace_ip(self, name: str, new_ip: str) -> None:
+        """Swap a server's address in place (ServerGroup.replaceIp
+        :811-950): health state resets and the checker re-targets; used
+        by the address updater when a hostname re-resolves."""
+        with self._lock:
+            for s in self.servers:
+                if s.name == name:
+                    if s.ip == new_ip:
+                        return
+                    s.ip = new_ip
+                    s.healthy = False
+                    s._up_cnt = s._down_cnt = 0
+                    self._recalc()
+                    # swap the checker under the lock: racing remove()
+                    # must not resurrect a checker for a gone server
+                    chk = self._checkers.pop(name, None)
+                    if chk:
+                        chk.stop()
+                    self._checkers[name] = _HealthChecker(
+                        self.elg.next(), self, s)
+                    return
+            raise KeyError(name)
 
     def set_weight(self, name: str, weight: int) -> None:
         with self._lock:

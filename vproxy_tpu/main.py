@@ -38,6 +38,15 @@ def _addr(s: str):
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+
+    # deployable apps (reference -Deploy=...): first arg selects the app
+    if argv and argv[0].lower() in ("simple", "helloworld", "daemon",
+                                    "kcptun"):
+        name = argv.pop(0).lower()
+        from . import apps
+        import importlib
+        mod = importlib.import_module(f".apps.{name}", __package__)
+        return mod.run(argv)
     opts = {"resp": DEFAULT_RESP, "resp_pass": None, "http": DEFAULT_HTTP,
             "load": None, "no_load": False, "no_save": False,
             "no_stdio": False, "workers": None, "inspect": None}
@@ -94,10 +103,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if opts["inspect"] is not None:
         from .utils.metrics import launch_inspection_http
-        launch_inspection_http(app.control_loop, opts["inspect"][0],
-                               opts["inspect"][1])
-        print(f"global-inspection on {opts['inspect'][0]}:"
-              f"{opts['inspect'][1]}")
+        try:
+            gi_srv = launch_inspection_http(
+                app.control_loop, opts["inspect"][0], opts["inspect"][1])
+        except OSError as e:
+            print(f"failed to start global-inspection: {e}",
+                  file=sys.stderr)
+            app.close()
+            return 1
+        print(f"global-inspection on {opts['inspect'][0]}:{gi_srv.port}")
 
     if opts["load"]:
         n = persist.load(app, opts["load"])
@@ -124,6 +138,10 @@ def main(argv: list[str] | None = None) -> int:
     if not opts["no_save"]:
         persist.start_auto_save(app)
 
+    from .components.updater import ServerAddressUpdater
+    updater = ServerAddressUpdater(lambda: app.server_groups.values())
+    updater.start()
+
     if not opts["no_stdio"]:
         def repl() -> None:
             for line in sys.stdin:
@@ -146,6 +164,7 @@ def main(argv: list[str] | None = None) -> int:
         threading.Thread(target=repl, daemon=True, name="stdio").start()
 
     stop.wait()
+    updater.close()
     app.close()
     return 0
 

@@ -261,7 +261,11 @@ class SelectorEventLoop:
     def loop(self) -> None:
         self._thread = threading.current_thread()
         from ..utils.metrics import GlobalInspection
-        GlobalInspection.get().register_loop(self)
+        gi = GlobalInspection.get()
+        gi.register_loop(self)
+        if self._closed:  # close() raced the thread start: undo
+            gi.deregister_loop(self)
+            return
         while not self._closed:
             self.one_poll()
 

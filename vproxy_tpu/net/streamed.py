@@ -62,12 +62,21 @@ class Stream:
         self.closed = False
         self._pending: deque = deque()
         self._pending_bytes = 0
+        self._eof_delivered = False
+        self._closed_delivered = False
 
     def set_handler(self, h: StreamHandler) -> None:
         self.handler = h
         while self._pending and not self.closed:
             h.on_data(self, self._pending.popleft())
         self._pending_bytes = 0
+        # lifecycle events that arrived while no handler was attached
+        if self.eof_rcvd and not self._eof_delivered and not self.closed:
+            self._eof_delivered = True
+            h.on_eof(self)
+        if self.closed and not self._closed_delivered:
+            self._closed_delivered = True
+            h.on_closed(self)
 
     # one PSH = one KCP message; keep well under KCP's fragment window
     # (255 frags / rcv_wnd) so any write size is legal
@@ -98,7 +107,9 @@ class Stream:
         self.closed = True
         self.sess.streams.pop(self.sid, None)
         if self.handler is not None:
+            self._closed_delivered = True
             self.handler.on_closed(self)
+        # else: delivered by set_handler when a handler attaches
 
 
 class StreamedSession(KcpHandler):
@@ -199,6 +210,7 @@ class StreamedSession(KcpHandler):
             if s is not None and not s.eof_rcvd:
                 s.eof_rcvd = True
                 if s.handler is not None:
+                    s._eof_delivered = True
                     s.handler.on_eof(s)
                 if s.eof_sent:
                     s._die()
