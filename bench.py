@@ -6,6 +6,22 @@ latency < 50us. A "rule-match" is one query classified against a full
 table (the reference does this with a linear Java scan per connection:
 Upstream.java:187, RouteTable.java:44, SecurityGroup.java:30).
 
+Measures the production fast path (cuckoo-hash kernels, ops/hashmatch)
+end to end, exactly the BASELINE.json contract: "ships batches of
+(5-tuple, SNI/Host, qname) to TPU and returns ServerGroup / next-hop
+indices". Per step: upload a fresh encoded query batch (h2d), run the
+fused hint+LPM+ACL classify, map matched rules to their ServerGroup /
+next-hop ids + ACL verdict on device, and return the packed per-query
+verdicts to the host. Readback is chunked (CHUNK steps stacked into one
+async d2h) and overlapped with compute — the data-plane analog of the
+event loop consuming verdict blocks as they land. Latency percentiles
+are submit->verdict-on-host per chunk, measured in the same regime.
+
+NOTE on this environment: the TPU here sits behind a network tunnel
+whose d2h path sustains ~12MB/s with a ~65ms floor (h2d ~1.5GB/s); on a
+directly-attached chip the same loop is h2d/compute-bound. The chunked
+readback keeps the tunnel out of the steady-state critical path.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
@@ -15,24 +31,23 @@ import time
 
 import numpy as np
 
-# honor the driver's environment; only force CPU if explicitly asked
-if "--cpu" in sys.argv:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-
 N_RULES = int(os.environ.get("BENCH_RULES", "100000"))
 N_ROUTE = int(os.environ.get("BENCH_ROUTES", "50000"))
 N_ACL = int(os.environ.get("BENCH_ACLS", "5000"))
-BATCH = int(os.environ.get("BENCH_BATCH", "4096"))
+N_GROUPS = int(os.environ.get("BENCH_GROUPS", "251"))  # ServerGroups
+N_NEXTHOP = int(os.environ.get("BENCH_NEXTHOPS", "120"))
+BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
+CHUNK = int(os.environ.get("BENCH_CHUNK", "64"))  # steps per d2h block
+ITERS = int(os.environ.get("BENCH_ITERS", "256"))
+NQ = int(os.environ.get("BENCH_QUERY_SETS", "4"))
 TARGET = 10_000_000.0  # rule-matches/sec north star
 
 
 def build():
+    from vproxy_tpu.ops import hashmatch as H
     from vproxy_tpu.ops import tables as T
-    from vproxy_tpu.ops.matchers import table_arrays
     from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto
     from vproxy_tpu.utils.ip import Network, mask_bytes
-
-    rnd = np.random.RandomState(11)
 
     def dom(i):
         return f"svc{i}.ns{i % 997}.apps.example.com"
@@ -61,70 +76,117 @@ def build():
             for i in range(N_ACL)]
 
     t0 = time.time()
-    ht = table_arrays(T.compile_hint_rules(hint_rules))
-    rt = table_arrays(T.compile_cidr_rules(routes))
-    at = table_arrays(T.compile_acl(acls, Proto.TCP))
+    ht = H.compile_hint_hash(hint_rules)
+    rt = H.compile_cidr_hash(routes)
+    at = H.compile_cidr_hash([r.network for r in acls], acl=acls)
     compile_s = time.time() - t0
 
-    hints = []
-    for i in range(BATCH):
-        j = int(rnd.randint(0, N_RULES))
-        if i % 3 == 0:
-            hints.append(Hint.of_host(dom(j)))
-        elif i % 3 == 1:
-            hints.append(Hint.of_host_uri("x." + dom(j), f"/api/v{j % 17}/u"))
-        else:
-            hints.append(Hint.of_host_port(dom(j), 443))
-    hq = T.encode_hints(hints)
-    addrs = [bytes([10 + (int(x) % 13)] + list(np.random.bytes(3)))
-             for x in rnd.randint(0, 13, BATCH)]
-    a16, fam = T.encode_ips(addrs)
-    ports = rnd.randint(1, 65535, size=BATCH).astype(np.int32)
-    return ht, rt, at, hq, (a16, fam), ports, compile_s
+    # rule -> ServerGroup / next-hop payload maps (devices gather these
+    # after the match so the host receives consumable indices)
+    hint_group = (np.arange(ht.r_cap, dtype=np.int32) % N_GROUPS)
+    route_tgt = (np.arange(rt.r_cap, dtype=np.int32) % N_NEXTHOP)
+
+    # a few distinct pre-encoded query sets cycled through the pipeline
+    qsets = []
+    for s in range(NQ):
+        rs = np.random.RandomState(100 + s)
+        hints = []
+        for i in range(BATCH):
+            j = int(rs.randint(0, N_RULES))
+            if i % 3 == 0:
+                hints.append(Hint.of_host(dom(j)))
+            elif i % 3 == 1:
+                hints.append(Hint.of_host_uri("x." + dom(j), f"/api/v{j % 17}/u"))
+            else:
+                hints.append(Hint.of_host_port(dom(j), 443))
+        hq = H.encode_hint_queries(hints, ht)
+        addrs = [bytes([10 + (int(x) % 13)] + list(rs.bytes(3)))
+                 for x in rs.randint(0, 13, BATCH)]
+        a16, fam = T.encode_ips(addrs)
+        ports = rs.randint(1, 65535, size=BATCH).astype(np.int32)
+        qsets.append((hq, a16, fam, ports))
+    return ht, rt, at, hint_group, route_tgt, qsets, compile_s
 
 
 def main():
     import jax
-    from vproxy_tpu.ops.bitmatch import unpack_bits
-    from vproxy_tpu.ops.matchers import cidr_match_jit, hint_match_jit
+    import jax.numpy as jnp
+    from vproxy_tpu.ops.hashmatch import cidr_hash_match, hint_hash_match
     from vproxy_tpu.rules.engine import _to_device
 
-    ht, rt, at, hq, (a16, fam), ports, compile_s = build()
-    ht, rt, at = _to_device(ht), _to_device(rt), _to_device(at)
-    uri_bits = np.asarray(unpack_bits(hq["uri"]))
+    assert N_GROUPS < 255 and N_NEXTHOP < 127, "u8 verdict packing bounds"
+    ht, rt, at, hint_group, route_tgt, qsets, compile_s = build()
+    htd, rtd, atd = (_to_device(ht.arrays), _to_device(rt.arrays),
+                     _to_device(at.arrays))
+    hgd, rtgd = jax.device_put(hint_group), jax.device_put(route_tgt)
 
-    def step():
-        hi, _ = hint_match_jit(ht, hq["host"], hq["has_host"], uri_bits,
-                               hq["has_uri"], hq["port"])
-        ri = cidr_match_jit(rt, a16, fam, None)
-        ai = cidr_match_jit(at, a16, fam, ports)
-        return hi, ri, ai
+    @jax.jit
+    def step_fn(ht_, rt_, at_, hg_, rtg_, hq, a16, fam, port):
+        hi, _ = hint_hash_match(ht_, hq)
+        ri = cidr_hash_match(rt_, a16, fam, None)
+        ai = cidr_hash_match(at_, a16, fam, port)
+        group = jnp.where(hi >= 0, hg_[jnp.maximum(hi, 0)] + 1, 0)
+        tgt = jnp.where(ri >= 0, rtg_[jnp.maximum(ri, 0)] + 1, 0)
+        allow = jnp.where(ai >= 0, at_["allow"][jnp.maximum(ai, 0)], True)
+        v1 = (allow.astype(jnp.uint8) << 7) | tgt.astype(jnp.uint8)
+        return jnp.stack([group.astype(jnp.uint8), v1], axis=1)  # [B,2] u8
+
+    def submit(qs):
+        hq, a16, fam, ports = qs
+        hqd = {k: jax.device_put(v) for k, v in hq.items()}
+        return step_fn(htd, rtd, atd, hgd, rtgd, hqd,
+                       jax.device_put(a16), jax.device_put(fam),
+                       jax.device_put(ports))
 
     # warmup / compile
     t0 = time.time()
-    out = step()
-    [o.block_until_ready() for o in out]
+    np.asarray(submit(qsets[0]))
     warm_s = time.time() - t0
 
-    iters = int(os.environ.get("BENCH_ITERS", "30"))
     lat = []
+    pending = []  # (first_submit_ts, stacked chunk on device)
+    cur = []
+    cur_t0 = None
+    done = 0
+
+    def land(p):
+        ts, arr = p
+        r = np.asarray(arr)
+        lat.append(time.time() - ts)
+        return r.shape[0] * r.shape[1]
+
     t0 = time.time()
-    for _ in range(iters):
-        t1 = time.time()
-        out = step()
-        [o.block_until_ready() for o in out]
-        lat.append(time.time() - t1)
+    for i in range(ITERS):
+        if cur_t0 is None:
+            cur_t0 = time.time()
+        cur.append(submit(qsets[i % NQ]))
+        if len(cur) == CHUNK:
+            arr = jnp.stack(cur)
+            arr.copy_to_host_async()
+            pending.append((cur_t0, arr))
+            cur, cur_t0 = [], None
+            while len(pending) > 2:  # keep readback off the critical path
+                done += land(pending.pop(0))
+    if cur:
+        arr = jnp.stack(cur)
+        arr.copy_to_host_async()
+        pending.append((cur_t0, arr))
+    for p in pending:
+        done += land(p)
     total = time.time() - t0
+    assert done == ITERS * BATCH
 
     # 3 classification queries per batch element (hint + route + acl)
-    matches = 3 * BATCH * iters
+    matches = 3 * BATCH * ITERS
     rate = matches / total
+    step_us = total / ITERS * 1e6
     p50 = float(np.percentile(lat, 50) * 1e6)
     p99 = float(np.percentile(lat, 99) * 1e6)
     sys.stderr.write(
-        f"# rules={N_RULES}+{N_ROUTE}+{N_ACL} batch={BATCH} iters={iters} "
-        f"compile={compile_s:.1f}s warmup={warm_s:.1f}s "
-        f"step p50={p50:.0f}us p99={p99:.0f}us platform={jax.devices()[0].platform}\n")
+        f"# rules={N_RULES}+{N_ROUTE}+{N_ACL} batch={BATCH} iters={ITERS} "
+        f"chunk={CHUNK} compile={compile_s:.1f}s warmup={warm_s:.1f}s "
+        f"step={step_us:.0f}us chunk-latency p50={p50:.0f}us p99={p99:.0f}us "
+        f"platform={jax.devices()[0].platform}\n")
     print(json.dumps({
         "metric": "rule-matches/sec @100k rules (Host+DNS hints, LPM, ACL)",
         "value": round(rate, 1),
@@ -134,4 +196,8 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--cpu" in sys.argv:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax as _jax  # sitecustomize may have pre-imported jax
+        _jax.config.update("jax_platforms", "cpu")
     main()

@@ -1,0 +1,225 @@
+"""Hash-path classify kernels vs the pure-Python oracle.
+
+The cuckoo/hash matchers (ops/hashmatch.py) are the production fast
+path; every semantic subtlety of Hint.matchLevel / ordered CIDR
+first-match that the dense matchers are tested for must hold here too,
+plus hash-specific ones: bucket sharing (many rules, one key), suffix
+probe positions, rebuild-on-update with cap reuse, wildcard keys.
+"""
+import random
+
+import numpy as np
+
+from vproxy_tpu.ops import hashmatch as H
+from vproxy_tpu.ops import tables as T
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.engine import CidrMatcher, HintMatcher
+from vproxy_tpu.rules.ir import (AclRule, Hint, HintRule, Proto, RouteRule,
+                                 RouteTable)
+from vproxy_tpu.utils.ip import Network, mask_bytes, parse_ip
+
+rnd = random.Random(1234)
+
+WORDS = ["a", "bb", "ccc", "x", "api", "web", "cdn", "img", "v2", "svc"]
+TLDS = ["com", "net", "io", "local"]
+
+
+def rand_domain():
+    n = rnd.randint(1, 3)
+    return ".".join(rnd.choice(WORDS) for _ in range(n)) + "." + rnd.choice(TLDS)
+
+
+def rand_uri():
+    n = rnd.randint(1, 4)
+    return "/" + "/".join(rnd.choice(WORDS) for _ in range(n))
+
+
+def rand_hint_rule():
+    host = uri = None
+    port = 0
+    while host is None and uri is None and port == 0:
+        if rnd.random() < 0.7:
+            host = "*" if rnd.random() < 0.1 else rand_domain()
+        if rnd.random() < 0.5:
+            uri = "*" if rnd.random() < 0.1 else rand_uri()
+        if rnd.random() < 0.3:
+            port = rnd.choice([80, 443, 8080])
+    return HintRule(host=host, port=port, uri=uri)
+
+
+def rand_hint():
+    host = rand_domain() if rnd.random() < 0.8 else None
+    if host and rnd.random() < 0.5:
+        host = rnd.choice(WORDS) + "." + host
+    uri = rand_uri() if rnd.random() < 0.6 else None
+    port = rnd.choice([0, 80, 443, 8080])
+    return Hint(host=host, port=port, uri=uri)
+
+
+def check_hints(rules, hints):
+    tab = H.compile_hint_hash(rules)
+    q = H.encode_hint_queries(hints, tab)
+    idx, level = H.hint_hash_match(tab.arrays, q)
+    idx, level = np.asarray(idx), np.asarray(level)
+    for i, h in enumerate(hints):
+        want = oracle.search(rules, h)
+        assert idx[i] == want, (i, h, int(idx[i]), want,
+                                rules[idx[i]] if idx[i] >= 0 else None,
+                                rules[want] if want >= 0 else None)
+        if want >= 0:
+            assert level[i] == oracle.match_level(h, rules[want])
+
+
+def test_hint_hash_parity_random():
+    rules = [rand_hint_rule() for _ in range(300)]
+    hints = [rand_hint() for _ in range(600)]
+    for i in range(0, 200, 3):
+        r = rules[i % len(rules)]
+        if r.host and r.host != "*":
+            hints[i] = Hint(host=r.host, port=r.port or 0, uri=r.uri)
+    check_hints(rules, hints)
+
+
+def test_hint_hash_shared_keys_and_tiebreak():
+    # many rules share one host: bucket must be scored, earliest index
+    # wins ties; later-but-higher-level must beat earlier-lower
+    rules = [
+        HintRule(host="a.com", uri="/x"),
+        HintRule(host="a.com", uri="/xy"),
+        HintRule(host="a.com"),
+        HintRule(host="a.com", port=443),
+        HintRule(host="a.com", uri="/xy"),  # dup of 1 — index 1 wins
+        HintRule(host="com"),  # suffix for *.com
+        HintRule(host="*", uri="/x"),
+        HintRule(uri="/xy"),  # uri-only rule
+        HintRule(uri="*"),
+    ]
+    hints = [
+        Hint(host="a.com", uri="/xyz"),
+        Hint(host="a.com", uri="/xy"),
+        Hint(host="a.com"),
+        Hint(host="a.com", port=443),
+        Hint(host="a.com", port=8080),
+        Hint(host="b.a.com", uri="/x"),
+        Hint(host="z.com"),
+        Hint(uri="/xyq"),
+        Hint(uri="/zzz"),
+        Hint(host="*"),           # exact match on the wildcard key
+        Hint(host="q.*"),         # suffix match on the wildcard key
+        Hint(uri="*"),            # exact uri match on wildcard uri key
+    ]
+    check_hints(rules, hints)
+
+
+def test_hint_hash_no_host_rules_and_empty():
+    rules = [HintRule(port=443), HintRule(uri="/a"), HintRule(host="h.io")]
+    hints = [Hint(port=443), Hint(host="h.io", port=443), Hint(uri="/a/b"),
+             Hint(host="x.h.io", uri="/a")]
+    check_hints(rules, hints)
+
+
+def test_hint_hash_long_host_boundaries():
+    # 64-byte rule host: exact + suffix probes at the window edge
+    h64 = "a" * 31 + "." + "b" * 32  # len 64
+    rules = [HintRule(host=h64), HintRule(host="b" * 32)]
+    hints = [Hint(host=h64), Hint(host="x." + h64), Hint(host="q" + h64)]
+    check_hints(rules, hints)
+
+
+def test_cidr_hash_route_parity():
+    rt = RouteTable()
+    for i in range(200):
+        ml = rnd.choice([0, 8, 12, 16, 24, 32])
+        ip = bytes([10 + i % 5, rnd.randint(0, 255), rnd.randint(0, 255), 0])
+        m = mask_bytes(ml)
+        net = Network(bytes(np.frombuffer(ip, np.uint8) &
+                            np.frombuffer(m, np.uint8)), m)
+        try:
+            rt.add(RouteRule(f"r{i}", net))
+        except ValueError:
+            continue
+    nets = [r.rule for r in rt.rules]
+    tab = H.compile_cidr_hash(nets)
+    addrs = [bytes([10 + rnd.randint(0, 6), rnd.randint(0, 255),
+                    rnd.randint(0, 255), rnd.randint(0, 255)])
+             for _ in range(400)]
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(H.cidr_hash_match(tab.arrays, a16, fam, None))
+    for i, a in enumerate(addrs):
+        want = next((j for j, n in enumerate(nets) if n.contains_ip(a)), -1)
+        assert got[i] == want, (i, a.hex(), int(got[i]), want)
+
+
+def test_cidr_hash_acl_port_buckets():
+    # same network, same proto, different port ranges + allow flags:
+    # one hash bucket, ordered first-match must pick by port
+    net = Network(parse_ip("10.1.0.0"), mask_bytes(16))
+    acl = [
+        AclRule("a", net, Proto.TCP, 80, 80, False),
+        AclRule("b", net, Proto.TCP, 0, 1000, True),
+        AclRule("c", net, Proto.TCP, 0, 65535, False),
+        AclRule("d", Network(parse_ip("0.0.0.0"), mask_bytes(0)),
+                Proto.TCP, 0, 65535, True),
+    ]
+    nets = [r.network for r in acl]
+    tab = H.compile_cidr_hash(nets, acl=acl)
+    addrs = [parse_ip("10.1.2.3")] * 4 + [parse_ip("9.9.9.9")]
+    ports = np.asarray([80, 443, 2000, 65535, 80], np.int32)
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(H.cidr_hash_match(tab.arrays, a16, fam, ports))
+    for i in range(len(addrs)):
+        want = oracle.acl_first_match(acl, Proto.TCP, addrs[i], int(ports[i]))
+        assert got[i] == want, (i, int(got[i]), want)
+
+
+def test_cidr_hash_mixed_families():
+    # v4 rule must match plain-v4, ::v4 and ::ffff:v4 queries; v6 /28
+    # (4-byte-mask case) compares only the first 4 bytes
+    v4net = Network(parse_ip("192.168.0.0"), mask_bytes(16))
+    v6net = Network(parse_ip("fd00::"), mask_bytes(8))
+    nets = [v4net, v6net]
+    tab = H.compile_cidr_hash(nets)
+    addrs = [parse_ip("192.168.3.4"),
+             parse_ip("::192.168.3.4"),
+             parse_ip("::ffff:192.168.3.4"),
+             parse_ip("fd00::1"),
+             parse_ip("192.169.0.1")]
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(H.cidr_hash_match(tab.arrays, a16, fam, None))
+    for i, a in enumerate(addrs):
+        want = next((j for j, n in enumerate(nets) if n.contains_ip(a)), -1)
+        assert got[i] == want, (i, int(got[i]), want)
+
+
+def test_engine_hash_backend_update_and_growth():
+    m = HintMatcher([HintRule(host="a.com")], backend="jax")
+    assert m.match([Hint(host="a.com")])[0] == 0
+    caps0 = dict(m._caps)
+    # same-shape update: caps must be reused (no shape growth)
+    m.set_rules([HintRule(host="b.com"), HintRule(host="a.com")])
+    assert m.match([Hint(host="a.com")])[0] == 1
+    assert m._caps["r_cap"] == caps0["r_cap"]
+    # growth past capacity recompiles with bigger caps, stays correct
+    rules = [HintRule(host=f"h{i}.x.io") for i in range(600)]
+    m.set_rules(rules)
+    got = m.match([Hint(host="h123.x.io"), Hint(host="sub.h7.x.io")])
+    assert got[0] == 123 and got[1] == 7
+
+
+def test_engine_cidr_hash_backend():
+    nets = [Network(parse_ip("10.0.0.0"), mask_bytes(8)),
+            Network(parse_ip("10.1.0.0"), mask_bytes(16))]
+    m = CidrMatcher(nets, backend="jax")
+    # list order wins: 10.1.x.y matches rule 0 first (insert order here)
+    assert m.match([parse_ip("10.1.2.3")])[0] == 0
+    assert m.match([parse_ip("11.0.0.1")])[0] == -1
+
+
+def test_hash_vs_dense_vs_host_cross_check():
+    rules = [rand_hint_rule() for _ in range(64)]
+    hints = [rand_hint() for _ in range(128)]
+    got = {}
+    for be in ("jax", "jax-dense", "host"):
+        got[be] = HintMatcher(rules, backend=be).match(hints)
+    np.testing.assert_array_equal(got["jax"], got["host"])
+    np.testing.assert_array_equal(got["jax-dense"], got["host"])

@@ -160,7 +160,10 @@ def run(argv: List[str]) -> int:
         return 1
     mode = argv[0]
     port = int(argv[1])
-    host, _, p = argv[2].rpartition(":")
+    host, sep, p = argv[2].rpartition(":")
+    if not sep or not host or not p.isdigit():
+        print(__doc__, file=sys.stderr)
+        return 1
     peer = (host, int(p))
     loop = SelectorEventLoop("kcptun")
     loop.loop_thread()

@@ -288,13 +288,14 @@ class ServerGroup:
         """Swap a server's address in place (ServerGroup.replaceIp
         :811-950): health state resets and the checker re-targets; used
         by the address updater when a hostname re-resolves."""
+        swapped = None
         with self._lock:
             for s in self.servers:
                 if s.name == name:
                     if s.ip == new_ip:
                         return
                     s.ip = new_ip
-                    s.healthy = False
+                    was_healthy, s.healthy = s.healthy, False
                     s._up_cnt = s._down_cnt = 0
                     self._recalc()
                     # swap the checker under the lock: racing remove()
@@ -304,8 +305,14 @@ class ServerGroup:
                         chk.stop()
                     self._checkers[name] = _HealthChecker(
                         self.elg.next(), self, s)
-                    return
-            raise KeyError(name)
+                    swapped = s if was_healthy else None
+                    break
+            else:
+                raise KeyError(name)
+        # down transition notifies like every health-checker edge does —
+        # outside the lock, listeners may re-enter the group
+        if swapped is not None:
+            self._notify(swapped, False)
 
     def set_weight(self, name: str, weight: int) -> None:
         with self._lock:

@@ -16,13 +16,13 @@ from typing import Callable, Dict, Iterable, Optional
 DEFAULT_PERIOD_S = 60.0
 
 
-def _resolve(host: str, want_v6: bool) -> Optional[str]:
+def _resolve_all(host: str, want_v6: bool) -> list[str]:
     try:
         fam = socket.AF_INET6 if want_v6 else socket.AF_INET
         infos = socket.getaddrinfo(host, None, fam, socket.SOCK_STREAM)
     except OSError:
-        return None
-    return infos[0][4][0] if infos else None
+        return []
+    return [i[4][0] for i in infos]
 
 
 class ServerAddressUpdater:
@@ -51,8 +51,11 @@ class ServerAddressUpdater:
             for s in list(g.servers):
                 if not s.host_name:
                     continue
-                new_ip = _resolve(s.host_name, ":" in s.ip)
-                if new_ip is not None and new_ip != s.ip:
+                # swap only when the current IP left the record set —
+                # multi-A round-robin answers must not flap the server
+                ips = _resolve_all(s.host_name, ":" in s.ip)
+                if ips and s.ip not in ips:
+                    new_ip = ips[0]
                     try:
                         g.replace_ip(s.name, new_ip)
                         changed[f"{g.alias}/{s.name}"] = new_ip

@@ -43,7 +43,6 @@ def main(argv: list[str] | None = None) -> int:
     if argv and argv[0].lower() in ("simple", "helloworld", "daemon",
                                     "kcptun"):
         name = argv.pop(0).lower()
-        from . import apps
         import importlib
         mod = importlib.import_module(f".apps.{name}", __package__)
         return mod.run(argv)

@@ -4,15 +4,22 @@ This is the TPU analog of the reference's per-connection match loops:
 components (Upstream, SecurityGroup, switch Table, DNSServer) register
 their rules here; data-plane code calls the batched query API. Mirrors
 the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
-`backend="jax" | "host"`: the host backend is the pure-Python oracle
-(correctness fallback + latency floor for tiny tables), the jax backend
-uploads compiled tables to the device and dispatches micro-batches.
+`backend="jax" | "jax-dense" | "host"`:
+
+* "host"      — the pure-Python oracle (correctness fallback + latency
+                floor for tiny tables).
+* "jax"       — DEFAULT: cuckoo-hash classify kernels (ops/hashmatch):
+                O(1) probes per query, gather-bound. The 10M matches/s
+                path.
+* "jax-dense" — the dense matmul kernels (ops/matchers): O(rules) MXU
+                work per query; kept as the brute-force cross-check and
+                for rule-axis mesh sharding experiments.
 
 Rule updates never retrace: tables are fixed-capacity (padded), and an
 update recompiles numpy arrays and re-uploads same-shape buffers (the
-double-buffer swap — README "Modifiable when running").  Capacity grows
-by bucket when exceeded, which recompiles the jitted matcher once for
-the new shape.
+double-buffer swap — README "Modifiable when running"). Capacity (or a
+cuckoo bucket tier) grows when exceeded, which recompiles the jitted
+matcher once for the new shapes.
 """
 from __future__ import annotations
 
@@ -21,9 +28,10 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..ops import hashmatch as H
 from ..ops import tables as T
-from ..ops.matchers import cidr_match_jit, hint_match_jit, table_arrays
 from ..ops.bitmatch import unpack_bits
+from ..ops.matchers import cidr_match_jit, hint_match_jit, table_arrays
 from . import oracle
 from .ir import AclRule, Hint, HintRule, Proto
 
@@ -58,6 +66,8 @@ class HintMatcher:
         self.backend = backend or default_backend()
         self._rules: list[HintRule] = list(rules)
         self._dev: Optional[dict] = None
+        self._tab = None  # hash-path table meta
+        self._caps: Optional[dict] = None
         self._recompile()
 
     @property
@@ -69,13 +79,27 @@ class HintMatcher:
         self._recompile()
 
     def _recompile(self) -> None:
-        if self.backend != "jax":
-            return
-        cap = self._dev["active"].shape[0] if self._dev is not None else None
-        if cap is not None and len(self._rules) > cap:
-            cap = None  # outgrew capacity: let the compiler pick a new bucket
-        tab = T.compile_hint_rules(self._rules, cap=cap)
-        self._dev = _to_device(table_arrays(tab))
+        if self.backend == "jax":
+            self._tab = H.compile_hint_hash(self._rules, caps=self._caps)
+            self._caps = self._tab.caps
+            self._dev = _to_device(self._tab.arrays)
+        elif self.backend == "jax-dense":
+            cap = self._dev["active"].shape[0] if self._dev is not None else None
+            if cap is not None and len(self._rules) > cap:
+                cap = None  # outgrew capacity: let the compiler pick a bucket
+            tab = T.compile_hint_rules(self._rules, cap=cap)
+            self._dev = _to_device(table_arrays(tab))
+
+    def encode(self, hints: Sequence[Hint]) -> dict:
+        """Pre-encode a query batch for submit() (hash backend only).
+        Bound to the current table version — re-encode after set_rules."""
+        assert self.backend == "jax"
+        return H.encode_hint_queries(hints, self._tab)
+
+    def submit(self, q: dict):
+        """Dispatch an encoded batch; returns the device array (async)."""
+        idx, _ = H.hint_hash_jit(self._dev, q)
+        return idx
 
     def match(self, hints: Sequence[Hint]) -> np.ndarray:
         """-> int32 [B] matched rule index, -1 for none."""
@@ -84,6 +108,8 @@ class HintMatcher:
         if self.backend == "host":
             return np.array([oracle.search(self._rules, h) for h in hints],
                             np.int32)
+        if self.backend == "jax":
+            return np.asarray(self.submit(self.encode(hints)))
         q = T.encode_hints(hints)
         idx, _ = hint_match_jit(
             self._dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
@@ -91,7 +117,7 @@ class HintMatcher:
         return np.asarray(idx)
 
     def match_one(self, hint: Hint) -> int:
-        if self.backend == "jax" and len(self._rules) <= SMALL_TABLE:
+        if self.backend != "host" and len(self._rules) <= SMALL_TABLE:
             return oracle.search(self._rules, hint)
         return int(self.match([hint])[0])
 
@@ -105,6 +131,7 @@ class CidrMatcher:
         self._nets = list(networks)
         self._acl = list(acl) if acl is not None else None
         self._dev: Optional[dict] = None
+        self._caps: Optional[dict] = None
         self._recompile()
 
     def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None) -> None:
@@ -113,13 +140,22 @@ class CidrMatcher:
         self._recompile()
 
     def _recompile(self) -> None:
-        if self.backend != "jax":
-            return
-        cap = self._dev["allow"].shape[0] if self._dev is not None else None
-        if cap is not None and len(self._nets) > cap:
-            cap = None
-        tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
-        self._dev = _to_device(table_arrays(tab))
+        if self.backend == "jax":
+            tab = H.compile_cidr_hash(self._nets, acl=self._acl, caps=self._caps)
+            self._caps = tab.caps
+            self._dev = _to_device(tab.arrays)
+        elif self.backend == "jax-dense":
+            cap = self._dev["allow"].shape[0] if self._dev is not None else None
+            if cap is not None and len(self._nets) > cap:
+                cap = None
+            tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
+            self._dev = _to_device(table_arrays(tab))
+
+    def submit(self, a16: np.ndarray, fam: np.ndarray,
+               ports: Optional[np.ndarray]):
+        """Dispatch an encoded batch; returns the device array (async)."""
+        p = None if (ports is None or self._acl is None) else ports
+        return H.cidr_hash_jit(self._dev, a16, fam, p)
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
@@ -132,6 +168,9 @@ class CidrMatcher:
                 [self._scan_one(a, None if ports is None else ports[i])
                  for i, a in enumerate(addrs)], np.int32)
         a16, fam = T.encode_ips(addrs)
+        if self.backend == "jax":
+            p = None if ports is None else np.asarray(ports, np.int32)
+            return np.asarray(self.submit(a16, fam, p))
         # route tables (acl=None) have zeroed port-range columns: the port
         # gate must be skipped entirely or every port>0 query misses
         p = None if (ports is None or self._acl is None) else np.asarray(ports, np.int32)
@@ -147,6 +186,6 @@ class CidrMatcher:
         return -1
 
     def match_one(self, addr: bytes, port: Optional[int] = None) -> int:
-        if self.backend == "jax" and len(self._nets) <= SMALL_TABLE:
+        if self.backend != "host" and len(self._nets) <= SMALL_TABLE:
             return self._scan_one(addr, port)
         return int(self.match([addr], None if port is None else [port])[0])
