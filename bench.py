@@ -195,9 +195,44 @@ def main():
     }))
 
 
+def _orchestrate():
+    """Try the TPU in a timed subprocess; fall back to a clean CPU run.
+
+    Round-1 failure modes this guards against: (a) the axon TPU-tunnel
+    plugin raising `Unable to initialize backend` when the tunnel is
+    down (BENCH_r01 rc=1) and (b) backend discovery HANGING inside the
+    plugin (MULTICHIP_r01 rc=124).  Both are unrecoverable in-process —
+    the plugin stays registered and re-dials on every retry — so each
+    attempt runs in its own child; the CPU child gets the plugin
+    stripped from PYTHONPATH entirely.
+    """
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    # Keep well under any external driver timeout: a hung tunnel must
+    # leave room for the CPU fallback to produce the JSON line.
+    tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "300"))
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--tpu"], timeout=tpu_timeout, cwd=here)
+        if r.returncode == 0:
+            return
+        sys.stderr.write(f"# TPU attempt rc={r.returncode}; "
+                         "retrying on CPU\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"# TPU attempt timed out ({tpu_timeout:.0f}s); "
+                         "retrying on CPU\n")
+    r = subprocess.run([sys.executable, os.path.abspath(__file__), "--cpu"],
+                       env=cpu_subprocess_env(), timeout=1800, cwd=here)
+    sys.exit(r.returncode)
+
+
 if __name__ == "__main__":
     if "--cpu" in sys.argv:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax as _jax  # sitecustomize may have pre-imported jax
-        _jax.config.update("jax_platforms", "cpu")
-    main()
+        from vproxy_tpu.utils.jaxenv import force_cpu
+        force_cpu()
+        main()
+    elif "--tpu" in sys.argv:
+        main()
+    else:
+        _orchestrate()

@@ -1,6 +1,6 @@
 """Test config: force a hermetic 8-device virtual CPU mesh.
 
-Two things must happen before jax is first imported:
+Two things must happen before jax initializes a backend:
 
 * JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8 — the
   real TPU here is a single chip; multi-chip sharding is validated on
@@ -8,23 +8,15 @@ Two things must happen before jax is first imported:
 * remove the axon TPU-tunnel plugin (/root/.axon_site) from sys.path —
   its registration eagerly dials the TPU pool even under
   JAX_PLATFORMS=cpu, which hangs tests whenever the tunnel is busy.
+
+Both live in vproxy_tpu.utils.jaxenv (shared with bench.py and
+__graft_entry__.py) — keep the logic there, not here.
 """
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-os.environ["PYTHONPATH"] = ":".join(
-    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p)
+from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
-# The axon sitecustomize pre-imports jax at interpreter start, freezing
-# jax_platforms=axon before the env vars above exist. The backend itself
-# is created lazily, so overriding the config value here (before any
-# jax.devices() call) still lands the tests on the 8-device virtual CPU.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)

@@ -24,7 +24,10 @@ from .bitmatch import mismatch_counts, unpack_bits
 from .tables import MATCH_CHUNK
 
 HOST_SHIFT = 10
-NO_MATCH = jnp.int32(-1)
+# Plain int, NOT jnp.int32(-1): a module-level jnp constant would touch
+# the device backend at import time and fail/hang when the TPU tunnel is
+# down (it weak-types to i32 inside the jitted matchers either way).
+NO_MATCH = -1
 
 
 def hint_match(table: dict, q_host: jnp.ndarray, q_has_host: jnp.ndarray,

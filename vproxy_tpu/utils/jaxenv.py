@@ -1,0 +1,91 @@
+"""JAX backend hygiene for driver entry points and tests.
+
+Two environment hazards (both observed in round 1, see tests/conftest.py):
+
+* The axon TPU-tunnel plugin (installed under ``~/.axon_site``) registers
+  itself via sitecustomize and eagerly dials the TPU pool during backend
+  discovery — even under ``JAX_PLATFORMS=cpu`` — hanging or raising
+  ``Unable to initialize backend`` whenever the tunnel is busy/down.
+* sitecustomize pre-imports jax at interpreter start, freezing
+  ``jax_platforms`` before our env vars exist, so plain ``os.environ``
+  settings are not enough; ``jax.config.update`` is required as well.
+
+``force_cpu(n)`` applies the full hygiene (strip plugin, force the cpu
+platform, request *n* virtual host devices) and is safe to call whether
+or not jax is already imported, as long as no device has been touched
+yet.  ``cpu_subprocess_env()`` builds a sanitized env for re-exec'ing a
+script on CPU after a TPU backend failure.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def strip_axon_plugin() -> None:
+    """Remove the axon TPU-tunnel plugin from module search paths."""
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ["PYTHONPATH"] = ":".join(
+        p for p in os.environ.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p)
+
+
+def _with_host_device_flag(flags: str, n_devices: int) -> str:
+    """Set (or replace a differing) host-device-count flag in *flags*."""
+    import re
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    new = f"--xla_force_host_platform_device_count={n_devices}"
+    if re.search(pat, flags):
+        return re.sub(pat, new, flags)
+    return (flags + " " + new).strip()
+
+
+def _ensure_host_device_flag(n_devices: int) -> None:
+    os.environ["XLA_FLAGS"] = _with_host_device_flag(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU platform (with *n_devices* virtual devices) robustly.
+
+    Idempotent; works whether jax is not-yet-imported, imported-but-idle,
+    or pre-imported by sitecustomize with platform=axon frozen in.
+    """
+    strip_axon_plugin()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        _ensure_host_device_flag(n_devices)
+    if "jax" in sys.modules:
+        import jax
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # config frozen; detection below still applies
+        # If a non-cpu backend is ALREADY live, proceeding would silently
+        # dial the tunnel (the round-1 rc=124 hang) — fail loud instead.
+        try:
+            from jax._src import xla_bridge as _xb
+            live = getattr(_xb, "_backends", {})
+            if live and "cpu" not in live:
+                raise RuntimeError(
+                    "force_cpu() called after a non-cpu jax backend was "
+                    f"initialized ({list(live)}); run in a fresh process "
+                    "(see cpu_subprocess_env)")
+        except ImportError:
+            pass  # private layout changed; keep best-effort behavior
+
+
+def cpu_subprocess_env(n_devices: int | None = None) -> dict:
+    """Env for re-exec'ing a script on CPU with the plugin stripped."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # The plugin (and the sitecustomize that pre-imports jax) reach the
+    # interpreter solely via the PYTHONPATH entry — dropping it here is a
+    # complete cure for the child process.
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p)
+    if n_devices:
+        env["XLA_FLAGS"] = _with_host_device_flag(
+            env.get("XLA_FLAGS", ""), n_devices)
+    return env
