@@ -222,8 +222,15 @@ def _orchestrate():
     except subprocess.TimeoutExpired:
         sys.stderr.write(f"# TPU attempt timed out ({tpu_timeout:.0f}s); "
                          "retrying on CPU\n")
+    env = cpu_subprocess_env()
+    # CPU evidence-of-life run: one step is ~5.6s at full batch/rules on
+    # this host, so the full ITERS=256 pipeline would run ~25 min; trim
+    # the iteration count (not the table: the metric is @100k rules)
+    env.setdefault("BENCH_ITERS", "16")
+    env.setdefault("BENCH_CHUNK", "8")
+    env.setdefault("BENCH_QUERY_SETS", "2")
     r = subprocess.run([sys.executable, os.path.abspath(__file__), "--cpu"],
-                       env=cpu_subprocess_env(), timeout=1800, cwd=here)
+                       env=env, timeout=1800, cwd=here)
     sys.exit(r.returncode)
 
 

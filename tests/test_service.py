@@ -1,0 +1,299 @@
+"""ClassifyService — the cross-connection micro-batch queue (north star).
+
+Covers: batching ratio (N concurrent queries -> far fewer device
+dispatches), correctness vs the host oracle, auto-mode policy, device
+failover to the oracle, and the live TcpLB http-splice data plane
+flowing through device batches end-to-end.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from vproxy_tpu.rules.engine import CidrMatcher, HintMatcher
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto
+from vproxy_tpu.rules.service import ClassifyService
+from vproxy_tpu.utils.ip import Network, mask_bytes
+
+
+@pytest.fixture(autouse=True)
+def fresh_service():
+    ClassifyService.reset()
+    yield
+    ClassifyService.reset()
+
+
+def mk_rules(n=50):
+    return [HintRule(host=f"svc{i}.example.com") for i in range(n)]
+
+
+def collect(n):
+    """-> (cb, results, done_event): cb collects n results."""
+    results = {}
+    done = threading.Event()
+    lock = threading.Lock()
+
+    def cb(i, idx):
+        with lock:
+            results[i] = idx
+            if len(results) == n:
+                done.set()
+
+    return cb, results, done
+
+
+def test_concurrent_queries_batch_into_few_dispatches():
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    m = HintMatcher(mk_rules(64))
+    n = 200
+    cb, results, done = collect(n)
+    hints = [Hint.of_host(f"svc{i % 64}.example.com") for i in range(n)]
+    # warm the jit so compile time doesn't serialize the first batch
+    m.match([Hint.of_host("warm.example.com")] * 16)
+
+    for i, h in enumerate(hints):
+        svc.submit_hint(m, h, lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(30)
+    # correctness vs oracle
+    for i, h in enumerate(hints):
+        assert results[i] == oracle.search(m.rules, h)
+    # the whole point: far fewer dispatches than queries
+    assert svc.stats.device_queries == n
+    assert svc.stats.dispatches < n / 4, (
+        f"{svc.stats.dispatches} dispatches for {n} queries — not batching")
+    assert svc.stats.max_batch >= 2
+
+
+def test_auto_mode_lone_small_query_uses_oracle():
+    svc = ClassifyService.get()
+    assert svc.mode == "auto"
+    m = HintMatcher(mk_rules(8))
+    cb, results, done = collect(1)
+    svc.submit_hint(m, Hint.of_host("svc3.example.com"),
+                    lambda idx, _pl: cb(0, idx))
+    assert done.wait(10)
+    assert results[0] == 3
+    assert svc.stats.oracle_queries == 1
+    assert svc.stats.dispatches == 0
+
+
+def test_cidr_batching_with_ports():
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    acls = [AclRule(f"r{i}",
+                    Network(bytes([10, i, 0, 0]), mask_bytes(16)),
+                    Proto.TCP, 1000, 2000, i % 2 == 0)
+            for i in range(32)]
+    m = CidrMatcher([a.network for a in acls], acl=acls)
+    n = 100
+    cb, results, done = collect(n)
+    queries = [(bytes([10, i % 40, 1, 2]), 1500 if i % 3 else 99)
+               for i in range(n)]
+    m.match([b"\x0a\x00\x00\x01"], [1500])  # warm jit
+    for i, (a, p) in enumerate(queries):
+        svc.submit_cidr(m, a, p, lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(30)
+    for i, (a, p) in enumerate(queries):
+        assert results[i] == m.oracle_one(a, p), (i, a, p)
+    assert svc.stats.dispatches < n / 4
+
+
+def test_device_failure_degrades_to_oracle_and_recovers():
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    svc.retry_s = 0.3
+    m = HintMatcher(mk_rules(16))
+
+    boom = {"on": True}
+    real_dispatch = m.dispatch_snap
+
+    def flaky(snap, hints):
+        if boom["on"]:
+            raise RuntimeError("tunnel dropped")
+        return real_dispatch(snap, hints)
+
+    m.dispatch_snap = flaky
+    # a batch while the device is broken: served by the oracle, no crash
+    cb, results, done = collect(10)
+    for i in range(10):
+        svc.submit_hint(m, Hint.of_host(f"svc{i}.example.com"),
+                        lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(10)
+    assert all(results[i] == i for i in range(10))
+    assert svc.stats.failovers >= 1
+    assert svc.stats.oracle_queries >= 10
+    assert not svc.device_ok()
+
+    # after retry_s the device is probed again and serves
+    boom["on"] = False
+    time.sleep(0.4)
+    cb2, results2, done2 = collect(4)
+    for i in range(4):
+        svc.submit_hint(m, Hint.of_host(f"svc{i}.example.com"),
+                        lambda idx, _pl, i=i: cb2(i, idx))
+    assert done2.wait(10)
+    assert all(results2[i] == i for i in range(4))
+    assert svc.stats.device_queries >= 4
+
+
+def test_rule_update_between_batches_stays_consistent():
+    """An update must swap table+rules atomically: results always match
+    ONE version's oracle, never a torn mix."""
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    rules_v1 = mk_rules(32)
+    rules_v2 = [HintRule(host=f"svc{i}.example.org") for i in range(32)]
+    m = HintMatcher(rules_v1)
+    m.match([Hint.of_host("warm.example.com")] * 16)
+
+    stop = threading.Event()
+
+    def updater():
+        while not stop.is_set():
+            m.set_rules(rules_v2)
+            m.set_rules(rules_v1)
+
+    th = threading.Thread(target=updater, daemon=True)
+    th.start()
+    try:
+        hint = Hint.of_host("svc7.example.com")  # matches v1 only
+        hint2 = Hint.of_host("svc7.example.org")  # matches v2 only
+        for _ in range(50):
+            n = 8
+            cb, results, done = collect(n)
+            for i in range(n):
+                svc.submit_hint(m, hint if i % 2 else hint2,
+                                lambda idx, _pl, i=i: cb(i, idx))
+            assert done.wait(10)
+            for i, idx in results.items():
+                # whichever version served the batch, 7 or -1 are the only
+                # legal answers; any other index means torn state
+                assert idx in (7, -1), results
+    finally:
+        stop.set()
+        th.join(timeout=2)
+
+
+def test_e2e_http_splice_flows_through_device_batches():
+    from tests.test_tcplb import IdServer, fast_hc, http_get_id, wait_healthy
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+
+    svc = ClassifyService.get()
+    svc.mode = "device"
+
+    elg = EventLoopGroup("w", 2)
+    s1, s2 = IdServer("A", http=True), IdServer("B", http=True)
+    g1 = ServerGroup("g1", elg, fast_hc(), "wrr")
+    g2 = ServerGroup("g2", elg, fast_hc(), "wrr")
+    lb = None
+    try:
+        g1.add("a", "127.0.0.1", s1.port, weight=1)
+        g2.add("b", "127.0.0.1", s2.port, weight=1)
+        wait_healthy(g1, 1)
+        wait_healthy(g2, 1)
+        ups = Upstream("u")
+        ups.add(g1, annotations=HintRule(host="a.example.com"))
+        ups.add(g2, annotations=HintRule(host="b.example.com"))
+        lb = TcpLB("lb", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="http-splice")
+        lb.start()
+
+        n = 40
+        out = [None] * n
+        ths = []
+
+        def one(i):
+            host = "a.example.com" if i % 2 else "b.example.com"
+            _, body = http_get_id(lb.bind_port, host)
+            out[i] = (host, body)
+
+        for i in range(n):
+            th = threading.Thread(target=one, args=(i,))
+            th.start()
+            ths.append(th)
+        for th in ths:
+            th.join(timeout=20)
+        for i, r in enumerate(out):
+            assert r is not None, f"request {i} did not finish"
+            host, body = r
+            assert body == ("A" if host.startswith("a.") else "B"), out[i]
+        # hint lookups rode the device in micro-batches
+        assert svc.stats.device_queries >= n
+        assert svc.stats.dispatches < svc.stats.queries
+    finally:
+        if lb is not None:
+            lb.stop()
+        for x in (g1, g2):
+            x.close()
+        for s in (s1, s2):
+            s.close()
+        elg.close()
+
+
+def test_dns_query_rides_the_queue():
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.dns import packet as P
+    from vproxy_tpu.dns.server import DNSServer
+    from tests.test_tcplb import fast_hc
+
+    svc = ClassifyService.get()
+    svc.mode = "device"
+
+    elg = EventLoopGroup("w", 1)
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    srv = None
+    try:
+        g.add("a", "10.1.2.3", 80, weight=1)
+        g.servers[0].healthy = True  # no live hc target; force healthy
+        ups = Upstream("rr")
+        ups.add(g, annotations=HintRule(host="web.example.com"))
+        srv = DNSServer("dns", elg.next(), "127.0.0.1", 0, ups)
+        srv.start()
+
+        q = P.Packet(id=7, is_resp=False, rd=True, questions=[
+            P.Question(qname="web.example.com.", qtype=P.A)])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(5)
+        s.sendto(q.encode(), ("127.0.0.1", srv.bind_port))
+        data, _ = s.recvfrom(4096)
+        s.close()
+        resp = P.parse(data)
+        assert resp.id == 7 and resp.answers
+        assert resp.answers[0].rdata == bytes([10, 1, 2, 3])
+        assert svc.stats.queries >= 1
+    finally:
+        if srv is not None:
+            srv.stop()
+        g.close()
+        elg.close()
+
+
+def test_mixed_port_and_portless_cidr_queries_keep_semantics():
+    """port=None means 'ignore port ranges' — it must not be coerced to
+    port 0 when sharing a flush with port-carrying queries."""
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    acls = [AclRule(f"r{i}",
+                    Network(bytes([10, i, 0, 0]), mask_bytes(16)),
+                    Proto.TCP, 1000, 2000, True)
+            for i in range(20)]
+    m = CidrMatcher([a.network for a in acls], acl=acls)
+    m.match([b"\x0a\x00\x00\x01"], [1500])  # warm jit
+    n = 40
+    cb, results, done = collect(n)
+    # even i: port-carrying (in range); odd i: port=None (range ignored)
+    queries = [(bytes([10, i % 20, 1, 2]), 1500 if i % 2 == 0 else None)
+               for i in range(n)]
+    for i, (a, p) in enumerate(queries):
+        svc.submit_cidr(m, a, p, lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(30)
+    for i, (a, p) in enumerate(queries):
+        assert results[i] == m.oracle_one(a, p) == i % 20, (i, results[i])

@@ -63,7 +63,7 @@ class SecurityGroup:
             self._tables.pop(proto, None)
             return
         m = CidrMatcher([r.network for r in sub], backend=self._backend,
-                        acl=sub)
+                        acl=sub, payload=sub)
         self._tables[proto] = (m, sub)  # atomic publish
 
     def allow(self, proto: Proto, addr: bytes, port: int) -> bool:
@@ -73,6 +73,24 @@ class SecurityGroup:
         m, sub = ent
         idx = m.match_one(addr, port)
         return sub[idx].allow if idx >= 0 else self.default_allow
+
+    def allow_async(self, proto: Proto, addr: bytes, port: int, cb,
+                    loop=None) -> None:
+        """Async allow(): the CIDR+port lookup rides the ClassifyService
+        micro-batch queue; cb(bool) fires on *loop*. Empty rule sets
+        short-circuit synchronously (the common allow-all group costs
+        nothing)."""
+        ent = self._tables.get(proto)
+        if ent is None:
+            cb(self.default_allow)
+            return
+        from ..rules.service import ClassifyService
+        m, _ = ent
+
+        def on_idx(idx: int, sub) -> None:
+            cb(sub[idx].allow if sub and idx >= 0 else self.default_allow)
+
+        ClassifyService.get().submit_cidr(m, addr, port, on_idx, loop)
 
     def allow_batch(self, proto: Proto, addrs: Sequence[bytes],
                     ports: Sequence[int]) -> list[bool]:

@@ -51,6 +51,23 @@ class Socks5Server(TcpLB):
 
     # ---------------------------------------------------------- selection
 
+    def pick_target_async(self, client_ip: bytes, atyp: int, addr, port: int,
+                          cb, loop=None) -> None:
+        """Async pick_target: the domain classify rides the
+        ClassifyService micro-batch queue; cb(connector, direct_addr)."""
+        if atyp == ATYP_DOMAIN:
+            def on_conn(c) -> None:
+                if c is not None:
+                    cb(c, None)
+                elif self.allow_non_backend:
+                    cb(None, (addr, port))
+                else:
+                    cb(None, None)
+            self.backend.seek_async(client_ip, Hint.of_host_port(addr, port),
+                                    on_conn, loop=loop)
+            return
+        cb(*self._pick_literal(addr, port))
+
     def pick_target(self, client_ip: bytes, atyp: int, addr, port: int
                     ) -> tuple[Optional[Connector], Optional[tuple[str, int]]]:
         """-> (connector, direct_addr). Only one is non-None on success."""
@@ -61,6 +78,10 @@ class Socks5Server(TcpLB):
             if self.allow_non_backend:
                 return None, (addr, port)
             return None, None
+        return self._pick_literal(addr, port)
+
+    def _pick_literal(self, addr, port: int
+                      ) -> tuple[Optional[Connector], Optional[tuple[str, int]]]:
         ip_str = format_ip(addr)
         # match the literal ip:port against known backend servers
         for h in self.backend.handles:
@@ -144,13 +165,17 @@ class _Socks5Session(Handler):
         del self.buf[:need]
         self.state = self.ST_DONE
 
-        connector, direct = self.server.pick_target(
-            parse_ip(self.client_ip), atyp, addr, port)
-        if connector is None and direct is None:
-            self._reply(conn, REP_NOT_ALLOWED)
-            return
-        target = (connector.ip, connector.port) if connector else direct
-        self._connect_and_splice(conn, connector, target)
+        def picked(connector, direct) -> None:
+            if conn.closed:
+                return
+            if connector is None and direct is None:
+                self._reply(conn, REP_NOT_ALLOWED)
+                return
+            target = (connector.ip, connector.port) if connector else direct
+            self._connect_and_splice(conn, connector, target)
+
+        self.server.pick_target_async(
+            parse_ip(self.client_ip), atyp, addr, port, picked, self.loop)
 
     def _reply(self, conn: Connection, rep: int) -> None:
         conn.write(b"\x05" + bytes([rep]) + b"\x00\x01\x00\x00\x00\x00\x00\x00")

@@ -106,15 +106,27 @@ class TcpLB:
 
     def _on_accept(self, loop, cfd: int, ip: str, port: int) -> None:
         self.accepted += 1
-        # ACL gate (SecurityGroup.allow — TcpLB.java:168-171)
-        if not self.security_group.allow(Proto.TCP, parse_ip(ip), self.bind_port):
-            vtl.close(cfd)
-            return
-        if self.worker is not self.acceptor:
-            wl = self.worker.next()
-            wl.run_on_loop(lambda: self._serve(wl, cfd, ip, port))
-        else:
-            self._serve(loop, cfd, ip, port)
+
+        # ACL gate (SecurityGroup.allow — TcpLB.java:168-171); the lookup
+        # rides the ClassifyService micro-batch queue, coalescing with
+        # other in-flight accepts across connections/loops
+        def on_verdict(ok: bool) -> None:
+            if not ok or not self.started:
+                vtl.close(cfd)
+                return
+            if self.worker is not self.acceptor:
+                wl = self.worker.next()
+                if not wl.run_on_loop(lambda: self._serve(wl, cfd, ip, port)):
+                    vtl.close(cfd)  # worker loop died; don't leak the fd
+            else:
+                self._serve(loop, cfd, ip, port)
+
+        try:
+            self.security_group.allow_async(Proto.TCP, parse_ip(ip),
+                                            self.bind_port, on_verdict, loop)
+        except Exception:
+            vtl.close(cfd)  # classify queue unavailable: refuse, not leak
+            raise
 
     def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
         if self.holder is not None:
@@ -211,15 +223,22 @@ class TcpLB:
                 if parser.done:
                     conn.pause_reading()
                     hint = parser.hint()
-                    back = lb.backend.next(parse_ip(ip), hint)
-                    if back is None:
-                        conn.write(b"HTTP/1.1 503 Service Unavailable\r\n"
-                                   b"content-length: 0\r\nconnection: close\r\n\r\n")
-                        loop.delay(50, conn.close)
-                        return
-                    buffered = bytes(parser.buf)
-                    ffd = conn.detach()
-                    lb._splice(loop, ffd, back, buffered)
+
+                    # classify via the cross-connection micro-batch queue
+                    def on_back(back) -> None:
+                        if conn.closed or conn.detached:
+                            return
+                        if back is None:
+                            conn.write(b"HTTP/1.1 503 Service Unavailable\r\n"
+                                       b"content-length: 0\r\nconnection: close\r\n\r\n")
+                            loop.delay(50, conn.close)
+                            return
+                        buffered = bytes(parser.buf)
+                        ffd = conn.detach()
+                        lb._splice(loop, ffd, back, buffered)
+
+                    lb.backend.next_async(parse_ip(ip), hint, on_back,
+                                          loop=loop)
 
             def on_eof(self, conn: Connection) -> None:
                 conn.close()

@@ -77,7 +77,11 @@ class Upstream:
         raise KeyError(group.alias)
 
     def _recalc(self) -> None:
-        self._matcher.set_rules([h.merged_rule() for h in self.handles])
+        # the handle list is the rules' payload: published atomically
+        # with the compiled table so async classify results map their
+        # index through the SAME generation (see HintMatcher._pub)
+        self._matcher.set_rules([h.merged_rule() for h in self.handles],
+                                payload=list(self.handles))
         groups = [h for h in self.handles if h.weight > 0]
         self._wrr_groups = groups
         self._wrr_seq = ServerGroup._wrr_compute(groups) if groups else []
@@ -106,6 +110,9 @@ class Upstream:
             c = self.seek(source_ip, hint, fam)
             if c is not None:
                 return c
+        return self._wrr_next(source_ip, fam)
+
+    def _wrr_next(self, source_ip: bytes, fam: Optional[str]) -> Optional[Connector]:
         with self._lock:
             seq, groups = self._wrr_seq, self._wrr_groups
             for _ in range(len(seq) + 1):
@@ -117,3 +124,60 @@ class Upstream:
                 if c is not None:
                     return c
             return None
+
+    # ------------------------------------------------- batched data plane
+
+    def search_for_group_async(self, hint: Hint, cb, loop=None) -> None:
+        """Async search_for_group via the ClassifyService micro-batch
+        queue; cb(GroupHandle | None) fires on *loop*. The handle list
+        arrives as the matcher generation's payload, so the index is
+        always interpreted against the same add/remove generation that
+        the device table encoded."""
+        if not self.handles:
+            cb(None)
+            return
+        from ..rules.service import ClassifyService
+
+        def on_idx(idx: int, handles) -> None:
+            cb(handles[idx] if handles and 0 <= idx < len(handles) else None)
+
+        ClassifyService.get().submit_hint(self._matcher, hint, on_idx, loop)
+
+    def next_async(self, source_ip: bytes, hint: Optional[Hint], cb,
+                   fam: Optional[str] = None, loop=None) -> None:
+        """Async `next`: the hint classify rides the ClassifyService
+        micro-batch queue (rules/service.py) instead of a per-connection
+        device dispatch; cb(Connector | None) fires on *loop*.
+
+        This is the replacement for the reference's per-connection scan
+        in Upstream.searchForGroup (Upstream.java:187-198)."""
+        if hint is None or not self.handles:
+            cb(self._wrr_next(source_ip, fam))
+            return
+        from ..rules.service import ClassifyService
+
+        def on_idx(idx: int, handles) -> None:
+            if handles and 0 <= idx < len(handles):
+                c = handles[idx].group.next(source_ip, fam)
+                if c is not None:
+                    cb(c)
+                    return
+            cb(self._wrr_next(source_ip, fam))
+
+        ClassifyService.get().submit_hint(self._matcher, hint, on_idx, loop)
+
+    def seek_async(self, source_ip: bytes, hint: Hint, cb,
+                   fam: Optional[str] = None, loop=None) -> None:
+        """Async `seek` (hint-only, no WRR fallback); cb(Connector|None)."""
+        if not self.handles:
+            cb(None)
+            return
+        from ..rules.service import ClassifyService
+
+        def on_idx(idx: int, handles) -> None:
+            if handles and 0 <= idx < len(handles):
+                cb(handles[idx].group.next(source_ip, fam))
+            else:
+                cb(None)
+
+        ClassifyService.get().submit_hint(self._matcher, hint, on_idx, loop)

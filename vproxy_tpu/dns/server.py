@@ -74,19 +74,27 @@ class DNSServer:
     # --------------------------------------------------------- data plane
 
     def _on_readable(self, fd: int, ev: int) -> None:
+        # drain the socket; every datagram's ACL gate is submitted to the
+        # ClassifyService immediately, so a burst of queries coalesces
+        # into one device batch (the DNS arm of the north-star queue)
         while self._fd is not None:
             r = vtl.recvfrom(fd)
             if r is None:
                 return
             data, ip, port = r
             self.queries += 1
-            if not self.security_group.allow(Proto.UDP, parse_ip(ip), self.bind_port):
-                continue
-            try:
-                req = P.parse(data)
-            except P.DNSFormatError:
-                continue
-            self._handle(req, ip, port)
+
+            def gated(ok: bool, data=data, ip=ip, port=port) -> None:
+                if not ok or self._fd is None:
+                    return
+                try:
+                    req = P.parse(data)
+                except P.DNSFormatError:
+                    return
+                self._handle(req, ip, port)
+
+            self.security_group.allow_async(Proto.UDP, parse_ip(ip),
+                                            self.bind_port, gated, self.loop)
 
     def _respond(self, req: P.Packet, ip: str, port: int,
                  answers: list, rcode: int = 0) -> None:
@@ -100,8 +108,15 @@ class DNSServer:
         if not req.questions:
             self._respond(req, ip, port, [], rcode=1)
             return
-        answers: list[P.Record] = []
-        for q in req.questions:
+        # continuation pipeline over the questions: each rrsets lookup
+        # rides the ClassifyService queue (DNSServer.java:136's scan),
+        # coalescing with other in-flight queries across datagrams
+        self._handle_q(req, ip, port, list(req.questions), 0, [])
+
+    def _handle_q(self, req: P.Packet, ip: str, port: int, qs: list,
+                  i: int, answers: list) -> None:
+        while i < len(qs):
+            q = qs[i]
             if q.qtype not in (P.A, P.AAAA, P.SRV, P.ANY):
                 self._run_recursive(req, ip, port)
                 return
@@ -109,33 +124,43 @@ class DNSServer:
             host_hit = self.hosts.get(domain)
             if host_hit is not None:
                 answers.append(self._addr_record(q.qname, host_hit))
+                i += 1
                 continue
-            gh = self.rrsets.search_for_group(Hint.of_host(domain))
-            if gh is None:
-                if is_ip_literal(domain):
-                    addr = parse_ip(domain)
-                    if ((q.qtype == P.A and len(addr) == 4)
-                            or (q.qtype == P.AAAA and len(addr) == 16)
-                            or q.qtype == P.SRV):
-                        answers.append(self._addr_record(q.qname, addr))
-                    continue
-                self._run_recursive(req, ip, port)
-                return
-            if q.qtype == P.SRV:
-                for svr in gh.group.servers:
-                    if not svr.healthy:
-                        continue
-                    answers.append(P.Record(
-                        name=q.qname, rtype=P.SRV, ttl=self.ttl,
-                        rdata=(0, svr.weight, svr.port,
-                               (svr.host_name or svr.ip) + ".")))
-            else:
-                fam = "v4" if q.qtype == P.A else ("v6" if q.qtype == P.AAAA else None)
-                conn = gh.group.next(parse_ip(ip), fam)
-                if conn is None:
-                    continue  # no healthy server: empty answer section
-                answers.append(self._addr_record(q.qname, parse_ip(conn.ip)))
+
+            def found(gh, q=q, i=i, domain=domain) -> None:
+                if gh is None:
+                    if is_ip_literal(domain):
+                        addr = parse_ip(domain)
+                        if ((q.qtype == P.A and len(addr) == 4)
+                                or (q.qtype == P.AAAA and len(addr) == 16)
+                                or q.qtype == P.SRV):
+                            answers.append(self._addr_record(q.qname, addr))
+                        self._handle_q(req, ip, port, qs, i + 1, answers)
+                        return
+                    self._run_recursive(req, ip, port)
+                    return
+                self._answer_group(q, gh, ip, answers)
+                self._handle_q(req, ip, port, qs, i + 1, answers)
+
+            self.rrsets.search_for_group_async(Hint.of_host(domain), found,
+                                               self.loop)
+            return
         self._respond(req, ip, port, answers)
+
+    def _answer_group(self, q, gh, ip: str, answers: list) -> None:
+        if q.qtype == P.SRV:
+            for svr in gh.group.servers:
+                if not svr.healthy:
+                    continue
+                answers.append(P.Record(
+                    name=q.qname, rtype=P.SRV, ttl=self.ttl,
+                    rdata=(0, svr.weight, svr.port,
+                           (svr.host_name or svr.ip) + ".")))
+        else:
+            fam = "v4" if q.qtype == P.A else ("v6" if q.qtype == P.AAAA else None)
+            conn = gh.group.next(parse_ip(ip), fam)
+            if conn is not None:  # no healthy server: empty answer section
+                answers.append(self._addr_record(q.qname, parse_ip(conn.ip)))
 
     def _addr_record(self, qname: str, addr: bytes) -> P.Record:
         return P.Record(name=qname, rtype=P.A if len(addr) == 4 else P.AAAA,

@@ -161,17 +161,20 @@ class SelectorEventLoop:
     def next_tick(self, fn: Callable[[], None]) -> None:
         self._tick_q.append(fn)
 
-    def run_on_loop(self, fn: Callable[[], None]) -> None:
-        """Thread-safe submit + wakeup."""
+    def run_on_loop(self, fn: Callable[[], None]) -> bool:
+        """Thread-safe submit + wakeup. Returns False when the loop is
+        gone and the task was dropped (callers owning resources must then
+        clean up themselves — e.g. ClassifyService delivery)."""
         if not self._alive():
-            return  # loop is gone; drop the task (reference logs + ignores)
+            return False
         if threading.current_thread() is self._thread:
             self.next_tick(fn)
-            return
+            return True
         with self._xq_lock:
             self._xq.append(fn)
         if self._lp is not None:
             vtl.LIB.vtl_wakeup(self._lp)
+        return True
 
     def call_sync(self, fn: Callable[[], object], timeout: float = 5.0):
         """Run fn on the loop thread, block until it finishes, return its

@@ -62,20 +62,30 @@ def _to_device(arrs: dict) -> dict:
 class HintMatcher:
     """Device-backed (or host-fallback) Upstream/DNS hint matcher."""
 
-    def __init__(self, rules: Sequence[HintRule] = (), backend: Optional[str] = None):
+    def __init__(self, rules: Sequence[HintRule] = (), backend: Optional[str] = None,
+                 payload=None):
         self.backend = backend or default_backend()
         self._rules: list[HintRule] = list(rules)
         self._dev: Optional[dict] = None
         self._tab = None  # hash-path table meta
         self._caps: Optional[dict] = None
+        # (tab, dev, rules, payload) published as ONE tuple so concurrent
+        # readers (the ClassifyService dispatcher) never see a torn
+        # table/rule/payload version across a set_rules() swap; `payload`
+        # is an opaque owner-supplied object versioned WITH the rules
+        # (e.g. Upstream's GroupHandle list) so a matched index is always
+        # interpreted against the same generation it was matched in
+        self._pub: tuple = (None, None, [], payload)
+        self._payload = payload
         self._recompile()
 
     @property
     def rules(self) -> list[HintRule]:
         return list(self._rules)
 
-    def set_rules(self, rules: Sequence[HintRule]) -> None:
+    def set_rules(self, rules: Sequence[HintRule], payload=None) -> None:
         self._rules = list(rules)
+        self._payload = payload
         self._recompile()
 
     def _recompile(self) -> None:
@@ -89,6 +99,7 @@ class HintMatcher:
                 cap = None  # outgrew capacity: let the compiler pick a bucket
             tab = T.compile_hint_rules(self._rules, cap=cap)
             self._dev = _to_device(table_arrays(tab))
+        self._pub = (self._tab, self._dev, list(self._rules), self._payload)
 
     def encode(self, hints: Sequence[Hint]) -> dict:
         """Pre-encode a query batch for submit() (hash backend only).
@@ -103,40 +114,74 @@ class HintMatcher:
 
     def match(self, hints: Sequence[Hint]) -> np.ndarray:
         """-> int32 [B] matched rule index, -1 for none."""
-        if not self._rules or not hints:
-            return np.full(len(hints), -1, np.int32)
-        if self.backend == "host":
-            return np.array([oracle.search(self._rules, h) for h in hints],
+        snap = self._pub
+        if self.backend == "host" and snap[2] and hints:
+            return np.array([oracle.search(snap[2], h) for h in hints],
                             np.int32)
-        if self.backend == "jax":
-            return np.asarray(self.submit(self.encode(hints)))
-        q = T.encode_hints(hints)
-        idx, _ = hint_match_jit(
-            self._dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
-            q["has_uri"], q["port"])
-        return np.asarray(idx)
+        return np.asarray(self.dispatch_snap(snap, hints))
 
     def match_one(self, hint: Hint) -> int:
         if self.backend != "host" and len(self._rules) <= SMALL_TABLE:
             return oracle.search(self._rules, hint)
         return int(self.match([hint])[0])
 
+    # ---- ClassifyService API (rules/service.py) ----
+
+    def size(self) -> int:
+        return len(self._pub[2])
+
+    def snapshot(self) -> tuple:
+        """One consistent (table, device, rules, payload) generation."""
+        return self._pub
+
+    @staticmethod
+    def snap_payload(snap: tuple):
+        return snap[3]
+
+    def oracle_snap(self, snap: tuple, hint: Hint) -> int:
+        return oracle.search(snap[2], hint)
+
+    def oracle_one(self, hint: Hint) -> int:
+        return self.oracle_snap(self._pub, hint)
+
+    def dispatch_snap(self, snap: tuple, hints: Sequence[Hint]):
+        """Encode + submit one batch against the snapshotted table
+        generation (async device result; np.asarray() it to block)."""
+        tab, dev, rules, _ = snap
+        if not rules or not hints:
+            return np.full(len(hints), -1, np.int32)
+        if self.backend == "jax":
+            q = H.encode_hint_queries(hints, tab)
+            idx, _ = H.hint_hash_jit(dev, q)
+            return idx
+        q = T.encode_hints(hints)
+        idx, _ = hint_match_jit(
+            dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
+            q["has_uri"], q["port"])
+        return idx
+
 
 class CidrMatcher:
     """Device-backed ordered first-match CIDR matcher (routes / ACL)."""
 
     def __init__(self, networks: Sequence = (), backend: Optional[str] = None,
-                 acl: Optional[Sequence[AclRule]] = None):
+                 acl: Optional[Sequence[AclRule]] = None, payload=None):
         self.backend = backend or default_backend()
         self._nets = list(networks)
         self._acl = list(acl) if acl is not None else None
         self._dev: Optional[dict] = None
         self._caps: Optional[dict] = None
+        # (dev, nets, acl, payload) — one atomic generation (see
+        # HintMatcher._pub for the why)
+        self._pub: tuple = (None, [], None, payload)
+        self._payload = payload
         self._recompile()
 
-    def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None) -> None:
+    def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
+                     payload=None) -> None:
         self._nets = list(networks)
         self._acl = list(acl) if acl is not None else None
+        self._payload = payload
         self._recompile()
 
     def _recompile(self) -> None:
@@ -150,42 +195,67 @@ class CidrMatcher:
                 cap = None
             tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
             self._dev = _to_device(table_arrays(tab))
-
-    def submit(self, a16: np.ndarray, fam: np.ndarray,
-               ports: Optional[np.ndarray]):
-        """Dispatch an encoded batch; returns the device array (async)."""
-        p = None if (ports is None or self._acl is None) else ports
-        return H.cidr_hash_jit(self._dev, a16, fam, p)
+        self._pub = (self._dev, list(self._nets),
+                     None if self._acl is None else list(self._acl),
+                     self._payload)
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
         """-> int32 [B] first matching rule index (order = insert order), -1
         for none."""
-        if not self._nets or not addrs:
-            return np.full(len(addrs), -1, np.int32)
-        if self.backend == "host":
+        snap = self._pub
+        if self.backend == "host" and snap[1] and addrs:
             return np.array(
-                [self._scan_one(a, None if ports is None else ports[i])
+                [self.oracle_snap(snap, a, None if ports is None else ports[i])
                  for i, a in enumerate(addrs)], np.int32)
-        a16, fam = T.encode_ips(addrs)
-        if self.backend == "jax":
-            p = None if ports is None else np.asarray(ports, np.int32)
-            return np.asarray(self.submit(a16, fam, p))
-        # route tables (acl=None) have zeroed port-range columns: the port
-        # gate must be skipped entirely or every port>0 query misses
-        p = None if (ports is None or self._acl is None) else np.asarray(ports, np.int32)
-        idx = cidr_match_jit(self._dev, a16, fam, p)
-        return np.asarray(idx)
+        return np.asarray(self.dispatch_snap(snap, addrs, ports))
 
     def _scan_one(self, addr: bytes, port: Optional[int]) -> int:
-        for j, net in enumerate(self._nets):
-            if net.contains_ip(addr) and (
-                    port is None or self._acl is None or
-                    (self._acl[j].min_port <= port <= self._acl[j].max_port)):
-                return j
-        return -1
+        return self.oracle_snap(self._pub, addr, port)
+
+    def oracle_one(self, addr: bytes, port: Optional[int] = None) -> int:
+        return self.oracle_snap(self._pub, addr, port)
 
     def match_one(self, addr: bytes, port: Optional[int] = None) -> int:
         if self.backend != "host" and len(self._nets) <= SMALL_TABLE:
             return self._scan_one(addr, port)
         return int(self.match([addr], None if port is None else [port])[0])
+
+    # ---- ClassifyService API (rules/service.py) ----
+
+    def size(self) -> int:
+        return len(self._pub[1])
+
+    def snapshot(self) -> tuple:
+        """One consistent (device, nets, acl, payload) generation."""
+        return self._pub
+
+    @staticmethod
+    def snap_payload(snap: tuple):
+        return snap[3]
+
+    def oracle_snap(self, snap: tuple, addr: bytes,
+                    port: Optional[int] = None) -> int:
+        _, nets, acl, _ = snap
+        for j, net in enumerate(nets):
+            if net.contains_ip(addr) and (
+                    port is None or acl is None or
+                    (acl[j].min_port <= port <= acl[j].max_port)):
+                return j
+        return -1
+
+    def dispatch_snap(self, snap: tuple, addrs: Sequence[bytes],
+                      ports: Optional[Sequence[int]]):
+        """Encode + submit one batch against the snapshotted table
+        generation (async device result; np.asarray() it to block)."""
+        dev, nets, acl, _ = snap
+        if not nets or not addrs:
+            return np.full(len(addrs), -1, np.int32)
+        a16, fam = T.encode_ips(addrs)
+        # route tables (acl=None) have zeroed port-range columns: the port
+        # gate must be skipped entirely or every port>0 query misses
+        p = None if (ports is None or acl is None) \
+            else np.asarray(ports, np.int32)
+        if self.backend == "jax":
+            return H.cidr_hash_jit(dev, a16, fam, p)
+        return cidr_match_jit(dev, a16, fam, p)

@@ -1,0 +1,274 @@
+"""ClassifyService — the cross-connection micro-batching dispatch queue.
+
+THE north-star mechanism (BASELINE.json): data-plane code (TcpLB hint
+classify, SecurityGroup ACL gates, DNS qname lookup, switch routing)
+never dispatches the device per connection; it enqueues a query with a
+callback and the service coalesces everything that arrives while the
+previous device batch is in flight into ONE dispatch ("natural
+batching": the dispatch latency itself is the batch window, so the queue
+adapts from batch=1 at idle to hundreds under load with no timer).
+
+This replaces the reference's per-connection linear scans
+(Upstream.searchForGroup Upstream.java:187-198, SecurityGroup.allow
+SecurityGroup.java:30-45, RouteTable.lookup RouteTable.java:44) with a
+shared per-process batching front to the compiled device tables.
+
+Dispatch-path policy (mode = VPROXY_TPU_CLASSIFY, default "auto"):
+
+* "auto"   — a flushed batch goes to the device when it has >= 2 queries
+             (micro-batch) or the table is big (> SMALL_TABLE rules, the
+             same threshold match_one uses); lone queries against small
+             tables take the ~1us host oracle instead of a ~1ms device
+             round trip.
+* "device" — every flushed batch goes to the device (used by tests and
+             benchmarks to force the TPU path end-to-end).
+* "host"   — pure oracle (latency floor; also the correctness baseline).
+
+Failure containment: if a device dispatch raises (TPU tunnel drop — a
+demonstrated mode in this environment), the service logs one alarm,
+serves that batch and everything after it from the host oracle, and
+re-probes the device every RETRY_S seconds. Accepts never die with a
+classify backtrace.
+
+Batch shapes are padded to power-of-two buckets (min 16) so the jitted
+matchers compile a handful of programs, not one per batch size.
+
+Callbacks are delivered on the submitting event loop via run_on_loop()
+(loop-confinement discipline, SURVEY §5 race-detection row); submissions
+without a loop get the callback on the dispatcher thread.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..utils.log import Logger
+from .engine import SMALL_TABLE
+from .ir import Hint
+
+_log = Logger("classify")
+
+RETRY_S = float(os.environ.get("VPROXY_TPU_DEVICE_RETRY_S", "5"))
+
+
+def _pad_pow2(n: int, lo: int = 16) -> int:
+    c = lo
+    while c < n:
+        c <<= 1
+    return c
+
+
+class _Req:
+    __slots__ = ("payload", "cb", "loop")
+
+    def __init__(self, payload, cb, loop):
+        self.payload = payload
+        self.cb = cb
+        self.loop = loop
+
+
+class ClassifyStats:
+    """Counters surfaced via utils/metrics GlobalInspection."""
+
+    def __init__(self):
+        self.queries = 0          # total submitted
+        self.dispatches = 0       # device batches dispatched
+        self.device_queries = 0   # queries answered by the device
+        self.oracle_queries = 0   # queries answered by the host oracle
+        self.failovers = 0        # device errors that degraded a batch
+        self.max_batch = 0
+
+    def snapshot(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "queries", "dispatches", "device_queries", "oracle_queries",
+            "failovers", "max_batch")}
+
+
+class ClassifyService:
+    _instance: Optional["ClassifyService"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "ClassifyService":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Test hook: drop the singleton (a new one lazily respawns)."""
+        with cls._instance_lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.close()
+
+    def __init__(self, mode: Optional[str] = None):
+        self.mode = mode or os.environ.get("VPROXY_TPU_CLASSIFY", "auto")
+        self.retry_s = RETRY_S
+        self.stats = ClassifyStats()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # key -> (kind, matcher, list[_Req]); key identifies the matcher
+        self._pending: dict[int, tuple[str, object, list[_Req]]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._device_down_until = 0.0
+
+    # ------------------------------------------------------------- submit
+
+    def submit_hint(self, matcher, hint: Hint,
+                    cb: Callable[[int, object], None], loop=None) -> None:
+        """Queue one hint classify; cb(rule_idx, payload) with idx=-1 for
+        no match and payload = the matcher generation's attached object
+        (Upstream registers its GroupHandle list there so idx is always
+        interpreted against the generation that produced it)."""
+        self._submit("hint", matcher, hint, cb, loop)
+
+    def submit_cidr(self, matcher, addr: bytes, port: Optional[int],
+                    cb: Callable[[int, object], None], loop=None) -> None:
+        """Queue one route/ACL lookup; cb(first-match idx, payload), -1
+        for none. port=None skips ACL port-range gating entirely."""
+        self._submit("cidr", matcher, (addr, port), cb, loop)
+
+    def _submit(self, kind: str, matcher, payload, cb, loop) -> None:
+        with self._cv:
+            if self._closed:
+                raise OSError("ClassifyService is closed")
+            self.stats.queries += 1
+            key = id(matcher)
+            ent = self._pending.get(key)
+            if ent is None:
+                self._pending[key] = (kind, matcher, [_Req(payload, cb, loop)])
+            else:
+                ent[2].append(_Req(payload, cb, loop))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="classify-dispatch", daemon=True)
+                self._thread.start()
+            self._cv.notify()
+
+    # ---------------------------------------------------------- dispatcher
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._pending:
+                    return
+                batches = list(self._pending.values())
+                self._pending.clear()
+            for kind, matcher, reqs in batches:
+                try:
+                    self._dispatch(kind, matcher, reqs)
+                except Exception:
+                    # the dispatcher thread must survive ANY per-batch
+                    # error (incl. oracle/delivery bugs) — a dead thread
+                    # would strand every future classify silently.
+                    # Callbacks get -1 ("no match") so callers proceed.
+                    _log.error("classify dispatch failed; delivering "
+                               "no-match to batch", exc=True)
+                    try:
+                        self._deliver(reqs, [-1] * len(reqs))
+                    except Exception:
+                        _log.error("classify delivery failed", exc=True)
+
+    def _use_device(self, matcher, n: int) -> bool:
+        if self.mode == "host" or getattr(matcher, "backend", "host") == "host":
+            return False
+        if time.monotonic() < self._device_down_until:
+            return False
+        if self.mode == "device":
+            return True
+        # auto: micro-batches always ride the device; lone queries only
+        # when the table is past the oracle's crossover size
+        return n >= 2 or matcher.size() > SMALL_TABLE
+
+    def _dispatch(self, kind: str, matcher, reqs: list[_Req]) -> None:
+        if kind == "cidr":
+            # port=None means "ignore port ranges" and must NOT share a
+            # device batch with port-carrying queries (it would be coerced
+            # to port 0 and gated against the ACL ranges)
+            with_p = [r for r in reqs if r.payload[1] is not None]
+            without = [r for r in reqs if r.payload[1] is None]
+            if with_p and without:
+                self._dispatch_uniform(kind, matcher, with_p)
+                self._dispatch_uniform(kind, matcher, without)
+                return
+        self._dispatch_uniform(kind, matcher, reqs)
+
+    def _dispatch_uniform(self, kind: str, matcher, reqs: list[_Req]) -> None:
+        n = len(reqs)
+        self.stats.max_batch = max(self.stats.max_batch, n)
+        snap = matcher.snapshot()  # ONE generation for device/oracle/payload
+        idxs = None
+        if self._use_device(matcher, n):
+            try:
+                idxs = self._device_batch(kind, matcher, snap, reqs)
+                self.stats.dispatches += 1
+                self.stats.device_queries += n
+            except Exception as e:
+                self.stats.failovers += 1
+                self._device_down_until = time.monotonic() + self.retry_s
+                _log.alert(f"device classify failed ({e!r}); serving from "
+                           f"host oracle, retry in {self.retry_s:.0f}s")
+        if idxs is None:
+            idxs = self._oracle_batch(kind, matcher, snap, reqs)
+            self.stats.oracle_queries += n
+        self._deliver(reqs, idxs, matcher.snap_payload(snap))
+
+    def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
+        n = len(reqs)
+        cap = _pad_pow2(n)
+        if kind == "hint":
+            hints = [r.payload for r in reqs]
+            hints += [Hint()] * (cap - n)
+            return np.asarray(matcher.dispatch_snap(snap, hints))[:n]
+        addrs = [r.payload[0] for r in reqs]
+        ports = [r.payload[1] for r in reqs]
+        addrs += [b"\x00\x00\x00\x00"] * (cap - n)
+        if ports[0] is not None:  # uniform batches only (see _dispatch)
+            ports = ports + [0] * (cap - n)
+        else:
+            ports = None
+        return np.asarray(matcher.dispatch_snap(snap, addrs, ports))[:n]
+
+    def _oracle_batch(self, kind: str, matcher, snap,
+                      reqs: list[_Req]) -> list[int]:
+        if kind == "hint":
+            return [matcher.oracle_snap(snap, r.payload) for r in reqs]
+        return [matcher.oracle_snap(snap, r.payload[0], r.payload[1])
+                for r in reqs]
+
+    def _deliver(self, reqs: list[_Req], idxs, payload=None) -> None:
+        """cb(idx) or cb(idx, payload) — payload is the matcher-owner's
+        object versioned with the table generation that served the batch
+        (None when the owner didn't register one). Callbacks run on the
+        submitting loop; if that loop is gone, inline on this thread so
+        cleanup (closing an accepted fd) still happens."""
+        for r, idx in zip(reqs, idxs):
+            i = int(idx)
+
+            def run(cb=r.cb, i=i) -> None:
+                try:
+                    cb(i, payload)
+                except Exception:
+                    _log.error("classify callback failed", exc=True)
+
+            if r.loop is None or not r.loop.run_on_loop(run):
+                run()
+
+    # ------------------------------------------------------------- control
+
+    def device_ok(self) -> bool:
+        return time.monotonic() >= self._device_down_until
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()

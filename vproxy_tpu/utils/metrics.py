@@ -141,6 +141,18 @@ class GlobalInspection:
                               lambda: len(self._open_fds()))
         self.registry.gauge_f("vproxy_thread_count",
                               lambda: threading.active_count())
+        # micro-batch classify queue (rules/service.py — the north-star
+        # data plane): batching ratio = queries / dispatches
+        for k in ("queries", "dispatches", "device_queries",
+                  "oracle_queries", "failovers", "max_batch"):
+            self.registry.gauge_f(
+                f"vproxy_classify_{k}", lambda k=k: self._classify_stat(k))
+
+    @staticmethod
+    def _classify_stat(key: str) -> float:
+        from ..rules.service import ClassifyService
+        svc = ClassifyService._instance
+        return 0.0 if svc is None else float(getattr(svc.stats, key))
 
     @classmethod
     def get(cls) -> "GlobalInspection":
