@@ -1,0 +1,168 @@
+"""Mesh-sharded hash classify (the production path, rule-axis sharded).
+
+Validates on the 8-device virtual CPU mesh (conftest) that the
+shard_map'd cuckoo-hash classify — per-device sub-tables + cross-shard
+pmax/pmin reductions — agrees exactly with the host oracle, including
+cross-shard tie-breaking (earliest global rule index wins equal levels)
+and first-match CIDR ordering across shard boundaries.
+"""
+import numpy as np
+import pytest
+
+from vproxy_tpu.ops import hashmatch as H
+from vproxy_tpu.ops import tables as T
+from vproxy_tpu.parallel import mesh as M
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.ir import AclRule, Hint, HintRule, Proto
+from vproxy_tpu.utils.ip import Network, mask_bytes
+
+
+def dom(i):
+    return f"svc{i}.ns{i % 13}.corp.example"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    import jax
+    assert len(jax.devices()) >= 8
+    mesh = M.make_mesh(8, batch=2)  # 2 batch shards x 4 rule shards
+
+    rules = []
+    for i in range(300):
+        k = i % 10
+        if k < 5:
+            rules.append(HintRule(host=dom(i)))
+        elif k < 7:
+            rules.append(HintRule(host=dom(i), uri=f"/v{i % 5}"))
+        elif k < 8:
+            rules.append(HintRule(host=dom(i % 50)))  # duplicate hosts:
+            # cross-shard tie -> earliest global index must win
+        elif k < 9:
+            rules.append(HintRule(host="*", uri=f"/w{i % 3}"))
+        else:
+            rules.append(HintRule(uri=f"/static/{i}"))
+
+    def v4net(i, ml):
+        ip = np.array([10, (i >> 8) & 0xFF, i & 0xFF, 0], np.uint8)
+        m = np.frombuffer(mask_bytes(ml), np.uint8)
+        return Network(bytes(ip & m), bytes(m))
+
+    # overlapping routes so first-match crosses shard boundaries
+    routes = [v4net(i // 2, 8 + (i % 15)) for i in range(200)]
+    acls = [AclRule(f"r{i}", v4net(i // 2, 8 + (i % 19)), Proto.TCP,
+                    (i * 7) % 50000, (i * 7) % 50000 + 2000, i % 2 == 0)
+            for i in range(120)]
+
+    ht = H.compile_hint_hash_sharded(rules, 4)
+    rt = H.compile_cidr_hash_sharded(routes, 4)
+    at = H.compile_cidr_hash_sharded(acls and [a.network for a in acls], 4,
+                                     acl=acls)
+    return mesh, rules, routes, acls, ht, rt, at
+
+
+def test_sharded_classify_matches_oracle(setup):
+    mesh, rules, routes, acls, ht, rt, at = setup
+    rnd = np.random.RandomState(5)
+    B = 64
+    hints = []
+    for i in range(B):
+        j = int(rnd.randint(0, 300))
+        if i % 4 == 0:
+            hints.append(Hint.of_host(dom(j)))
+        elif i % 4 == 1:
+            hints.append(Hint.of_host_uri("x." + dom(j), f"/v{j % 5}/y"))
+        elif i % 4 == 2:
+            hints.append(Hint(uri=f"/static/{j}"))
+        else:
+            hints.append(Hint.of_host("none.invalid"))
+    addrs = [bytes([10, int(rnd.randint(0, 2)), int(rnd.randint(0, 100)), 7])
+             for _ in range(B)]
+    ports = rnd.randint(1, 60000, B).astype(np.int32)
+
+    hq = H.encode_hint_queries_sharded(hints, ht)
+    a16, fam = T.encode_ips(addrs)
+    fn = M.make_sharded_classify(mesh, ht, rt, at, hq)
+    with mesh:
+        out = np.asarray(fn(M.shard_hash_table(ht, mesh),
+                            M.shard_hash_table(rt, mesh),
+                            M.shard_hash_table(at, mesh),
+                            M.shard_hint_queries_sharded(hq, mesh),
+                            a16, fam, ports))
+
+    for i in range(B):
+        want_h = oracle.search(rules, hints[i])
+        assert out[i, 0] == want_h, (i, hints[i], out[i, 0], want_h)
+        want_r = next((j for j, net in enumerate(routes)
+                       if net.contains_ip(addrs[i])), -1)
+        assert out[i, 1] == want_r, (i, addrs[i])
+        want_a = next((j for j, a in enumerate(acls)
+                       if a.match(addrs[i], int(ports[i]))), -1)
+        assert out[i, 2] == want_a, (i, addrs[i], int(ports[i]))
+
+
+def test_sharded_update_changes_results(setup):
+    """Double-buffer update: recompile with caps reuse (same shapes, no
+    retrace) and the same jitted fn must see the NEW rules."""
+    mesh, rules, routes, acls, ht, rt, at = setup
+    hints = [Hint.of_host("brand.new.example"), Hint.of_host(dom(0))]
+    B = 16
+    hints = hints + [Hint.of_host("pad.x")] * (B - len(hints))
+
+    hq = H.encode_hint_queries_sharded(hints, ht)
+    fn = M.make_sharded_classify(mesh, ht, rt, at, hq)
+    a16, fam = T.encode_ips([b"\x0a\x00\x00\x07"] * B)
+    ports = np.full(B, 443, np.int32)
+
+    with mesh:
+        out1 = np.asarray(fn(M.shard_hash_table(ht, mesh),
+                             M.shard_hash_table(rt, mesh),
+                             M.shard_hash_table(at, mesh),
+                             M.shard_hint_queries_sharded(hq, mesh),
+                             a16, fam, ports))
+        assert out1[0, 0] == oracle.search(rules, hints[0])  # wildcard hit
+        assert out1[1, 0] == 0  # exact host rule 0
+
+        # live update: new rule list, SAME caps -> same shapes
+        rules2 = [HintRule(host="brand.new.example")] + list(rules[1:])
+        ht2 = H.compile_hint_hash_sharded(rules2, 4,
+                                          caps=ht.shards[0].caps)
+        for s_old, s_new in zip(ht.shards, ht2.shards):
+            assert s_old.caps == s_new.caps, "caps reuse must not grow"
+        hq2 = H.encode_hint_queries_sharded(hints, ht2)
+        out2 = np.asarray(fn(M.shard_hash_table(ht2, mesh),
+                             M.shard_hash_table(rt, mesh),
+                             M.shard_hash_table(at, mesh),
+                             M.shard_hint_queries_sharded(hq2, mesh),
+                             a16, fam, ports))
+        rules2_want0 = oracle.search(rules2, hints[0])
+        assert rules2_want0 == 0 and out2[0, 0] == 0  # exact beats wildcard
+        assert out2[1, 0] == oracle.search(rules2, hints[1])  # changed
+
+
+def test_update_storm_no_retrace(setup):
+    """20 consecutive rule updates with caps reuse must hit ONE compiled
+    program — the jitted sharded classify never retraces (README
+    'Modifiable when running': updates re-upload same-shape buffers)."""
+    mesh, rules, routes, acls, ht, rt, at = setup
+    B = 16
+    hints = [Hint.of_host(dom(1))] * B
+    hq = H.encode_hint_queries_sharded(hints, ht)
+    fn = M.make_sharded_classify(mesh, ht, rt, at, hq)
+    a16, fam = T.encode_ips([b"\x0a\x00\x00\x07"] * B)
+    ports = np.full(B, 443, np.int32)
+
+    rtd = M.shard_hash_table(rt, mesh)
+    atd = M.shard_hash_table(at, mesh)
+    caps = ht.shards[0].caps
+    with mesh:
+        for k in range(20):
+            rules_k = [HintRule(host=f"gen{k}.example")] + list(rules[1:])
+            ht_k = H.compile_hint_hash_sharded(rules_k, 4, caps=caps)
+            assert ht_k.shards[0].caps == caps  # shapes frozen
+            hq_k = H.encode_hint_queries_sharded(
+                [Hint.of_host(f"gen{k}.example")] * B, ht_k)
+            out = np.asarray(fn(M.shard_hash_table(ht_k, mesh), rtd, atd,
+                                M.shard_hint_queries_sharded(hq_k, mesh),
+                                a16, fam, ports))
+            assert out[0, 0] == 0, (k, out[0, 0])
+    assert fn._cache_size() == 1, f"retraced: {fn._cache_size()} programs"

@@ -164,13 +164,17 @@ class SelectorEventLoop:
     def run_on_loop(self, fn: Callable[[], None]) -> bool:
         """Thread-safe submit + wakeup. Returns False when the loop is
         gone and the task was dropped (callers owning resources must then
-        clean up themselves — e.g. ClassifyService delivery)."""
-        if not self._alive():
-            return False
+        clean up themselves — e.g. ClassifyService delivery). True means
+        the task WILL run: enqueue and the closed-flag flip share one
+        lock, and close() drains tasks that raced the shutdown."""
         if threading.current_thread() is self._thread:
+            if not self._alive():
+                return False
             self.next_tick(fn)
             return True
         with self._xq_lock:
+            if not self._alive():
+                return False
             self._xq.append(fn)
         if self._lp is not None:
             vtl.LIB.vtl_wakeup(self._lp)
@@ -281,7 +285,8 @@ class SelectorEventLoop:
     def close(self) -> None:
         if self._closed:
             return
-        self._closed = True
+        with self._xq_lock:  # paired with run_on_loop's alive re-check
+            self._closed = True
         from ..utils.metrics import GlobalInspection
         GlobalInspection.get().deregister_loop(self)
         if self._thread is not None and self._thread is not threading.current_thread():
@@ -302,3 +307,11 @@ class SelectorEventLoop:
             vtl.close(fd)
         self._handlers.clear()
         vtl.LIB.vtl_free(lp)
+        # run_on_loop promised (returned True for) tasks that the loop
+        # thread may have missed between its last drain and seeing the
+        # closed flag — honor the promise here so resource cleanup in
+        # those closures (closing accepted fds) still happens
+        with self._xq_lock:
+            items, self._xq = self._xq, deque()
+        for fn in items:
+            _guard(fn)

@@ -588,3 +588,98 @@ def classify_hash_all(hint_t: dict, route_t: dict, acl_t: dict,
 hint_hash_jit = jax.jit(hint_hash_match)
 cidr_hash_jit = jax.jit(cidr_hash_match)
 classify_hash_jit = jax.jit(classify_hash_all)
+
+
+# ----------------------------------------------------- mesh-sharded path
+#
+# Rule-axis sharding for the hash path: the rule list is split into S
+# contiguous slices, each compiled into its OWN cuckoo table (hash
+# probing is slot-local, so sharding the compiled arrays directly would
+# turn every probe into a cross-device gather). All shards share one
+# unified `caps` dict, so the per-shard arrays have identical shapes and
+# stack along a leading shard axis that carries the mesh's "rules"
+# PartitionSpec. Each device runs the UNCHANGED single-shard kernel on
+# its local slice inside shard_map; the global winner is a two-phase
+# collective reduction (pmax best-level, then pmin global-index among
+# level-winners — exactly Upstream.java:187's strictly-greater-max +
+# earliest-index-tie semantics, distributed).
+
+
+@dataclass
+class ShardedHashTable:
+    """S per-shard tables with unified shapes, stacked for the mesh."""
+
+    shards: list  # per-shard HashHintTable | HashCidrTable
+    arrays: dict  # stacked [S, ...] numpy arrays
+    shard_size: int  # rules per shard (global idx = shard * size + local)
+    n: int
+    r_cap: int  # per-shard capacity
+
+
+def _unify_caps(tabs_caps: list) -> dict:
+    out: dict = {}
+    for c in tabs_caps:
+        for k, v in c.items():
+            out[k] = max(out.get(k, 0), v)
+    return out
+
+
+class CapsExceeded(Exception):
+    """A caps-reusing recompile outgrew the reused shapes — the caller's
+    no-retrace update contract cannot hold; rebuild tables + fn."""
+
+
+def _compile_sharded(items: Sequence, n_shards: int, compile_one,
+                     caps: Optional[dict]) -> ShardedHashTable:
+    """compile_one(slice, shard_idx, caps) -> per-shard table. When caps
+    is supplied (the runtime-update fast path), the result MUST fit:
+    growth raises CapsExceeded instead of silently changing shapes and
+    retracing the caller's jitted classify."""
+    reused = dict(caps) if caps else None
+    per = max(1, -(-len(items) // n_shards))  # ceil; empty tail shards ok
+    slices = [list(items[d * per: (d + 1) * per]) for d in range(n_shards)]
+    caps = dict(caps or {})
+    for _ in range(6):  # caps only grow; fixed point in a few rounds
+        tabs = [compile_one(s, d, caps) for d, s in enumerate(slices)]
+        merged = _unify_caps([t.caps for t in tabs])
+        if all(t.caps == merged for t in tabs):
+            if reused is not None and merged != reused:
+                raise CapsExceeded(
+                    f"update outgrew reused caps: {reused} -> {merged}")
+            arrays = {k: np.stack([t.arrays[k] for t in tabs])
+                      for k in tabs[0].arrays}
+            return ShardedHashTable(shards=tabs, arrays=arrays,
+                                    shard_size=per, n=len(items),
+                                    r_cap=tabs[0].r_cap)
+        caps = merged
+    raise RuntimeError("sharded table caps did not converge")
+
+
+def compile_hint_hash_sharded(rules: Sequence[HintRule], n_shards: int,
+                              caps: Optional[dict] = None) -> ShardedHashTable:
+    return _compile_sharded(
+        rules, n_shards,
+        lambda s, d, caps: compile_hint_hash(s, caps=caps), caps)
+
+
+def compile_cidr_hash_sharded(networks: Sequence, n_shards: int,
+                              acl: Optional[Sequence[AclRule]] = None,
+                              caps: Optional[dict] = None) -> ShardedHashTable:
+    per = max(1, -(-len(networks) // n_shards))
+    # each shard's ACL window follows its rule slice positionally
+    return _compile_sharded(
+        networks, n_shards,
+        lambda s, d, caps: compile_cidr_hash(
+            s, acl=None if acl is None else acl[d * per: d * per + len(s)],
+            caps=caps), caps)
+
+
+def encode_hint_queries_sharded(hints: Sequence,
+                                stab: ShardedHashTable) -> dict:
+    """Per-shard probe encoding stacked on the leading shard axis.
+
+    Probe slots/salts are shard-local, so the same hint batch encodes
+    differently per shard; each device receives only its own slice
+    (the stacked dims are sharded (rules, batch) on the mesh)."""
+    per = [encode_hint_queries(hints, t) for t in stab.shards]
+    return {k: np.stack([p[k] for p in per]) for k in per[0]}

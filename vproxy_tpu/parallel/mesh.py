@@ -70,3 +70,89 @@ def shard_addr_queries(addr: np.ndarray, fam: np.ndarray, mesh: Mesh,
     if port is None:
         return a, f, None
     return a, f, jax.device_put(port, NamedSharding(mesh, P("batch")))
+
+
+# ------------------------------------------------- hash-path (production)
+#
+# The cuckoo-hash tables (ops/hashmatch, "the 10M matches/s path") shard
+# by SLICING THE RULE LIST: ShardedHashTable stacks S per-shard compiled
+# tables on a leading axis that carries the "rules" PartitionSpec, and
+# each device runs the unchanged single-shard kernel on its local slice
+# under shard_map. The global winner is a two-phase collective: pmax of
+# the match level, then pmin of the global rule index among the level
+# winners — Upstream.java:187's strictly-greater-max/earliest-tie
+# semantics as an ICI reduction. CIDR first-match reduces with one pmin.
+
+
+def _leading_rules_spec(arrays: dict) -> dict:
+    return {k: P("rules", *([None] * (v.ndim - 1)))
+            for k, v in arrays.items()}
+
+
+def shard_hash_table(stab, mesh: Mesh) -> dict:
+    """device_put a ShardedHashTable's stacked arrays over the mesh."""
+    specs = _leading_rules_spec(stab.arrays)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in stab.arrays.items()}
+
+
+def shard_hint_queries_sharded(q: dict, mesh: Mesh) -> dict:
+    """Stacked per-shard hint encodings: (rules, batch, ...) sharded."""
+    return {k: jax.device_put(
+        v, NamedSharding(mesh, P("rules", "batch", *([None] * (v.ndim - 2)))))
+        for k, v in q.items()}
+
+
+def make_sharded_classify(mesh: Mesh, hint_stab, route_stab, acl_stab,
+                          example_hq: dict):
+    """-> jitted fn(ht, rt, at, hq, a16, fam, port) -> [B, 3] i32 global
+    (hint idx, route idx, acl idx), -1 for no match; runs the full hash
+    classify SPMD over the (batch, rules) mesh. example_hq: one output
+    of encode_hint_queries_sharded (shapes fix the query specs)."""
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..ops.hashmatch import cidr_hash_match, hint_hash_match
+
+    BIG = 2 ** 30
+    h_size = hint_stab.shard_size
+    r_size = route_stab.shard_size
+    a_size = acl_stab.shard_size
+
+    def body(ht, rt, at, hq, a16, fam, port):
+        sid = jax.lax.axis_index("rules").astype(jnp.int32)
+        ht0 = {k: v[0] for k, v in ht.items()}
+        hq0 = {k: v[0] for k, v in hq.items()}
+        hidx, hlvl = hint_hash_match(ht0, hq0)
+        lvl = jnp.where(hidx >= 0, hlvl, 0)
+        best_lvl = jax.lax.pmax(lvl, "rules")
+        gidx = jnp.where((lvl == best_lvl) & (hidx >= 0),
+                         sid * h_size + hidx, BIG)
+        gmin = jax.lax.pmin(gidx, "rules")
+        h_global = jnp.where(best_lvl > 0, gmin, -1)
+
+        def cidr_global(t, port_, size):
+            t0 = {k: v[0] for k, v in t.items()}
+            li = cidr_hash_match(t0, a16, fam, port_)
+            g = jax.lax.pmin(jnp.where(li >= 0, sid * size + li, BIG),
+                             "rules")
+            return jnp.where(g < BIG, g, -1)
+
+        r_global = cidr_global(rt, None, r_size)
+        a_global = cidr_global(at, port, a_size)
+        return jnp.stack([h_global, r_global, a_global], axis=1)
+
+    in_specs = (
+        _leading_rules_spec(hint_stab.arrays),
+        _leading_rules_spec(route_stab.arrays),
+        _leading_rules_spec(acl_stab.arrays),
+        {k: P("rules", "batch", *([None] * (v.ndim - 2)))
+         for k, v in example_hq.items()},
+        P("batch", None), P("batch"), P("batch"),
+    )
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("batch", None))
+    return jax.jit(fn)
