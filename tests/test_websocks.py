@@ -1,0 +1,223 @@
+"""WebSocks server + agent e2e (reference vproxyx websocks pair).
+
+agent(socks5/http-connect front) -> websocks server -> target, over
+plain TCP and over the KCP-streamed transport; fake-page serving and
+auth rejection on the server; PAC endpoint on the agent.
+"""
+import base64
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tests.test_tcplb import IdServer, fast_hc
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.websocks import common
+from vproxy_tpu.websocks.agent import (DomainChecker, WebSocksProxyAgent,
+                                       WebSocksServerRef)
+from vproxy_tpu.websocks.server import WebSocksProxyServer
+
+USERS = {"alice": "p4ssw0rd"}
+
+
+@pytest.fixture
+def stack():
+    objs = {"elg": EventLoopGroup("ws", 2), "close": []}
+    yield objs
+    for c in objs["close"]:
+        try:
+            c()
+        except Exception:
+            pass
+    objs["elg"].close()
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise TimeoutError(msg)
+        time.sleep(0.02)
+
+
+def mk_server(stack, **kw):
+    elg = stack["elg"]
+    srv = WebSocksProxyServer("ws", elg.next(), "127.0.0.1", 0, USERS, **kw)
+    srv.start()
+    stack["close"].append(srv.stop)
+    return srv
+
+
+def mk_agent(stack, srv, kcp=False, **kw):
+    elg = stack["elg"]
+    ref = WebSocksServerRef("127.0.0.1", srv.bind_port, "alice", "p4ssw0rd",
+                            kcp=kcp)
+    agent = WebSocksProxyAgent(elg, [ref], hc=fast_hc(), **kw)
+    stack["close"].append(agent.close)
+    wait_for(lambda: all(s.healthy for s in agent.group.servers),
+             msg="server hc")
+    return agent
+
+
+def socks5_fetch(port, host, target_port, payload=b"hello"):
+    """Minimal socks5 client: CONNECT host:port, send payload, read."""
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"\x05\x01\x00")
+    assert c.recv(2) == b"\x05\x00"
+    hb = host.encode()
+    c.sendall(b"\x05\x01\x00\x03" + bytes([len(hb)]) + hb +
+              struct.pack(">H", target_port))
+    rep = c.recv(10)
+    assert rep[:2] == b"\x05\x00", rep
+    c.sendall(payload)
+    data = b""
+    try:
+        while True:
+            d = c.recv(65536)
+            if not d:
+                break
+            data += d
+    except socket.timeout:
+        pass
+    c.close()
+    return data
+
+
+def test_agent_to_server_over_tcp(stack):
+    target = IdServer("T")
+    stack["close"].append(target.close)
+    srv = mk_server(stack)
+    agent = mk_agent(stack, srv)
+    # echo flavor: IdServer sends its id then echoes
+    got = socks5_fetch(agent.socks_port, "127.0.0.1", target.port, b"ping")
+    assert got == b"Tping"
+    assert srv.tunneled == 1
+
+
+def test_agent_to_server_over_kcp(stack):
+    target = IdServer("K")
+    stack["close"].append(target.close)
+    srv = mk_server(stack, kcp=True)
+    agent = mk_agent(stack, srv, kcp=True)
+    got = socks5_fetch(agent.socks_port, "127.0.0.1", target.port, b"ping")
+    assert got == b"Kping"
+
+
+def test_http_connect_front(stack):
+    target = IdServer("H")
+    stack["close"].append(target.close)
+    srv = mk_server(stack)
+    agent = mk_agent(stack, srv, http_connect_port=0)
+    c = socket.create_connection(("127.0.0.1", agent.http_connect_port),
+                                 timeout=5)
+    c.settimeout(5)
+    c.sendall(f"CONNECT 127.0.0.1:{target.port} HTTP/1.1\r\n"
+              f"host: x\r\n\r\n".encode())
+    head = b""
+    while b"\r\n\r\n" not in head:
+        head += c.recv(4096)
+    assert b" 200 " in head
+    c.sendall(b"yo")
+    data = b""
+    try:
+        while len(data) < 3:
+            d = c.recv(4096)
+            if not d:
+                break
+            data += d
+    except socket.timeout:
+        pass
+    c.close()
+    assert data == b"Hyo"
+
+
+def test_direct_rule_bypasses_proxy(stack):
+    target = IdServer("D")
+    stack["close"].append(target.close)
+    srv = mk_server(stack)
+    # only *.proxied.example goes through the server
+    agent = mk_agent(stack, srv, proxy_rules=("proxied.example",))
+    got = socks5_fetch(agent.socks_port, "127.0.0.1", target.port, b"x")
+    assert got == b"Dx"
+    assert srv.tunneled == 0  # server untouched: direct connect
+
+
+def test_fake_page_and_auth_reject(stack):
+    srv = mk_server(stack)
+    # plain browser GET -> fake page
+    c = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"GET / HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    data = b""
+    while True:
+        try:
+            d = c.recv(65536)
+        except socket.timeout:
+            break
+        if not d:
+            break
+        data += d
+    c.close()
+    assert b"200 OK" in data and b"Welcome" in data
+
+    # upgrade with a bad password -> 401
+    c = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+    c.settimeout(5)
+    bad = base64.b64encode(b"alice:wrong").decode()
+    c.sendall((f"GET / HTTP/1.1\r\nUpgrade: websocket\r\n"
+               f"Connection: Upgrade\r\nHost: x\r\n"
+               f"Sec-WebSocket-Key: abcd\r\nSec-WebSocket-Version: 13\r\n"
+               f"Sec-WebSocket-Protocol: socks5\r\n"
+               f"Authorization: Basic {bad}\r\n\r\n").encode())
+    head = b""
+    while b"\r\n\r\n" not in head:
+        d = c.recv(4096)
+        if not d:
+            break
+        head += d
+    c.close()
+    assert b" 401 " in head
+
+
+def test_pac_endpoint(stack):
+    srv = mk_server(stack)
+    agent = mk_agent(stack, srv, pac_port=0)
+    c = socket.create_connection(("127.0.0.1", agent.pac_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"GET /pac HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+    data = b""
+    while True:
+        try:
+            d = c.recv(65536)
+        except (socket.timeout, OSError):
+            break
+        if not d:
+            break
+        data += d
+    c.close()
+    assert b"FindProxyForURL" in data
+    assert str(agent.socks_port).encode() in data
+
+
+def test_domain_checker_rules():
+    c = DomainChecker(["corp.example", ":8443", "/^internal-/"])
+    assert c.needs_proxy("a.corp.example", 80)
+    assert c.needs_proxy("corp.example", 80)
+    assert not c.needs_proxy("corpXexample", 80)
+    assert not c.needs_proxy("other.com", 80)
+    assert c.needs_proxy("other.com", 8443)
+    assert c.needs_proxy("internal-db", 5432)
+    assert DomainChecker(["*"]).needs_proxy("anything", 1)
+
+
+def test_auth_hash_minute_window():
+    now = int(time.time() * 1000) // 60_000 * 60_000
+    hdr = common.auth_header("alice", "p4ssw0rd", minute_ms=now - 60_000)
+    assert common.validate_auth(hdr, USERS) == "alice"
+    hdr_old = common.auth_header("alice", "p4ssw0rd",
+                                 minute_ms=now - 180_000)
+    assert common.validate_auth(hdr_old, USERS) is None
+    assert common.validate_auth("Basic garbage!!", USERS) is None

@@ -41,7 +41,7 @@ def main(argv: list[str] | None = None) -> int:
 
     # deployable apps (reference -Deploy=...): first arg selects the app
     if argv and argv[0].lower() in ("simple", "helloworld", "daemon",
-                                    "kcptun"):
+                                    "kcptun", "websocks"):
         name = argv.pop(0).lower()
         import importlib
         mod = importlib.import_module(f".apps.{name}", __package__)
