@@ -129,6 +129,10 @@ class TcpLB:
             raise
 
     def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
+        """Owns cfd: every branch either hands it off or closes it exactly
+        once — including when `loop` died while the accept's ACL verdict
+        was in flight (the verdict then runs on the dispatcher thread, or
+        via the closed loop's promised-task drain)."""
         if self.holder is not None:
             self._serve_tls(loop, cfd, ip, port)
         elif self.protocol == "tcp":
@@ -140,7 +144,11 @@ class TcpLB:
         elif self.protocol == "http-splice":
             self._http_classify(loop, cfd, ip, port)
         else:
-            L7Engine(self, loop, cfd, ip, port, processors.get(self.protocol))
+            try:
+                L7Engine(self, loop, cfd, ip, port,
+                         processors.get(self.protocol))
+            except Exception:
+                pass  # L7Engine closes cfd on its failure paths
 
     def _serve_tls(self, loop, cfd: int, ip: str, port: int) -> None:
         """TLS termination: decrypted bytes run through the L7 engine (the
@@ -150,7 +158,11 @@ class TcpLB:
         from ..net.tls import TlsSocket
         from ..processors.base import TcpRelaySession
         from ..rules.ir import Hint
-        conn = Connection(loop, cfd, (ip, port))
+        try:
+            conn = Connection(loop, cfd, (ip, port))
+        except OSError:
+            vtl.close(cfd)
+            return
         tls = TlsSocket(conn, self.holder.front_context)
         if self.protocol == "tcp":
             def factory(eng, addr):
@@ -207,7 +219,11 @@ class TcpLB:
     def _http_classify(self, loop, cfd: int, ip: str, port: int) -> None:
         lb = self
         parser = HeadParser()
-        front = Connection(loop, cfd, (ip, port))
+        try:
+            front = Connection(loop, cfd, (ip, port))
+        except OSError:
+            vtl.close(cfd)
+            return
         # a client that never completes its head is dropped at the timeout
         def head_timeout() -> None:
             if not front.closed and not front.detached:

@@ -27,8 +27,10 @@ def splice_connect(loop, front_fd: int, ip: str, port: int, head: bytes,
     """
     try:
         back = Connection.connect(loop, ip, port)
-    except OSError:
+    except OSError as e:
         vtl.close(front_fd)
+        if on_done is not None:
+            on_done(0, 0, e.errno or -1)
         return
 
     class Back(Handler):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import base64
 import hashlib
+import os
 import time
 from typing import Optional
 
@@ -74,7 +75,11 @@ def validate_auth(header: Optional[str], users: dict) -> Optional[str]:
 
 
 def upgrade_request(host: str, user: str, password: str,
-                    client_key: str = "dGhlIHNhbXBsZSBub25jZQ==") -> bytes:
+                    client_key: Optional[str] = None) -> bytes:
+    if client_key is None:
+        # RFC 6455 4.1: a randomly selected 16-byte nonce per connection
+        # (a constant key would fingerprint the tunnel)
+        client_key = base64.b64encode(os.urandom(16)).decode()
     return (f"GET / HTTP/1.1\r\n"
             f"Upgrade: websocket\r\n"
             f"Connection: Upgrade\r\n"

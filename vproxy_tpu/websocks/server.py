@@ -55,7 +55,7 @@ class PageProvider:
         if self.root is not None:
             root = os.path.abspath(self.root)
             p = os.path.normpath(os.path.join(root, path.lstrip("/")))
-            if not p.startswith(root):
+            if os.path.commonpath([root, p]) != root:
                 return None
             if os.path.isdir(p):
                 p = os.path.join(p, "index.html")
