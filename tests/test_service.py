@@ -297,3 +297,84 @@ def test_mixed_port_and_portless_cidr_queries_keep_semantics():
     assert done.wait(30)
     for i, (a, p) in enumerate(queries):
         assert results[i] == m.oracle_one(a, p) == i % 20, (i, results[i])
+
+
+def test_latency_budget_reroutes_lone_big_table_queries():
+    """Weak #5: a lone accept against a big table must not eat an
+    over-budget device round trip forever — once the device EWMA blows
+    the budget and the oracle is faster, lone queries reroute (with
+    periodic re-probes of the device)."""
+    svc = ClassifyService.get()
+    assert svc.mode == "auto"
+    svc.budget_us = 1000.0  # 1ms budget
+    m = HintMatcher(mk_rules(300))  # > SMALL_TABLE
+    # make the device path artificially slow (tunnel-like: 50ms)
+    real = m.dispatch_snap
+
+    def slow(snap, hints):
+        time.sleep(0.05)
+        return real(snap, hints)
+
+    m.dispatch_snap = slow
+    m.match([Hint.of_host("warm.example.com")] * 16)  # warm jit
+
+    def lone(i):
+        cb, results, done = collect(1)
+        svc.submit_hint(m, Hint.of_host(f"svc{i}.example.com"),
+                        lambda idx, _pl: cb(0, idx))
+        assert done.wait(10)
+        return results[0]
+
+    # 1st lone query probes the device (EWMA unknown), then oracle probe,
+    # then steady-state reroutes to the oracle
+    for i in range(8):
+        assert lone(i) == i
+    assert svc.stats.budget_reroutes >= 4
+    assert svc.stats.oracle_queries >= 4
+    # correctness is unchanged either way
+    assert lone(123) == 123
+    # stats surface the latency contract
+    lat = svc.stats.latency_percentiles()
+    assert lat is not None and lat["n"] >= 9
+    assert lat["p50_us"] > 0
+    snap = svc.stats.snapshot()
+    assert "latency_p50_us" in snap and "budget_reroutes" in snap
+
+
+def test_latency_budget_off_keeps_device_for_lone_big_queries():
+    svc = ClassifyService.get()
+    assert svc.mode == "auto"
+    svc.budget_us = 0.0  # knob off -> previous behavior
+    m = HintMatcher(mk_rules(300))
+    m.match([Hint.of_host("warm.example.com")] * 16)
+    cb, results, done = collect(1)
+    svc.submit_hint(m, Hint.of_host("svc7.example.com"),
+                    lambda idx, _pl: cb(0, idx))
+    assert done.wait(10)
+    assert results[0] == 7
+    assert svc.stats.device_queries == 1
+    assert svc.stats.oracle_queries == 0
+
+
+def test_micro_batches_always_ride_device_despite_budget():
+    """n >= 2 is never rerouted by the budget policy."""
+    svc = ClassifyService.get()
+    assert svc.mode == "auto"
+    svc.budget_us = 1.0  # absurdly tight budget
+    svc._ewma["device"] = 1e6  # pretend the device is terrible
+    svc._ewma["oracle"] = 10.0
+    m = HintMatcher(mk_rules(300))
+    m.match([Hint.of_host("warm.example.com")] * 16)
+    n = 50
+    cb, results, done = collect(n)
+    for i in range(n):
+        svc.submit_hint(m, Hint.of_host(f"svc{i}.example.com"),
+                        lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(30)
+    for i in range(n):
+        assert results[i] == i
+    # the dispatcher may drain a few lone requests (rerouted by the
+    # budget) before submissions pile up, but every micro-batch (n>=2)
+    # must ride the device regardless of the absurd budget
+    assert svc.stats.max_batch >= 2
+    assert svc.stats.device_queries >= n - 10

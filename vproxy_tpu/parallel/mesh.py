@@ -103,6 +103,90 @@ def shard_hint_queries_sharded(q: dict, mesh: Mesh) -> dict:
         for k, v in q.items()}
 
 
+def _shard_map(body, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs)
+
+
+def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
+                         query_keys_ndim: dict):
+    """-> jitted fn(stacked_table, stacked_queries, shard_size) -> [B] i32
+    global hint-rule index (-1 none) for the ENGINE's jax-sharded
+    backend. shard_size is a traced scalar, so rule-count changes within
+    the same caps reuse the compiled program; caps (shape) changes just
+    retrace. Winner = pmax(match level) then pmin(global index) among
+    level winners — Upstream.java:187 semantics as an ICI reduction."""
+    import jax.numpy as jnp
+
+    from ..ops.hashmatch import hint_hash_match
+
+    BIG = 2 ** 30
+
+    def body(ht, hq, shard_size):
+        sid = jax.lax.axis_index("rules").astype(jnp.int32)
+        ht0 = {k: v[0] for k, v in ht.items()}
+        hq0 = {k: v[0] for k, v in hq.items()}
+        hidx, hlvl = hint_hash_match(ht0, hq0)
+        lvl = jnp.where(hidx >= 0, hlvl, 0)
+        best_lvl = jax.lax.pmax(lvl, "rules")
+        gidx = jnp.where((lvl == best_lvl) & (hidx >= 0),
+                         sid * shard_size + hidx, BIG)
+        gmin = jax.lax.pmin(gidx, "rules")
+        return jnp.where(best_lvl > 0, gmin, -1)
+
+    # ndim values are the STACKED ndims (leading shard axis included)
+    in_specs = (
+        {k: P("rules", *([None] * (nd - 1)))
+         for k, nd in table_keys_ndim.items()},
+        {k: P("rules", "batch", *([None] * (nd - 2)))
+         for k, nd in query_keys_ndim.items()},
+        P(),
+    )
+    return jax.jit(_shard_map(body, mesh, in_specs, P("batch")))
+
+
+def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
+                         with_port: bool):
+    """-> jitted fn(stacked_table, a16, fam, [port,] shard_size) -> [B]
+    i32 global first-match index (-1 none); first-match = one pmin over
+    global indices (insert order is preserved across contiguous rule
+    slices)."""
+    import jax.numpy as jnp
+
+    from ..ops.hashmatch import cidr_hash_match
+
+    BIG = 2 ** 30
+
+    if with_port:
+        def body(t, a16, fam, port, shard_size):
+            sid = jax.lax.axis_index("rules").astype(jnp.int32)
+            t0 = {k: v[0] for k, v in t.items()}
+            li = cidr_hash_match(t0, a16, fam, port)
+            g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
+                             "rules")
+            return jnp.where(g < BIG, g, -1)
+        q_specs = (P("batch", None), P("batch"), P("batch"), P())
+    else:
+        def body(t, a16, fam, shard_size):
+            sid = jax.lax.axis_index("rules").astype(jnp.int32)
+            t0 = {k: v[0] for k, v in t.items()}
+            li = cidr_hash_match(t0, a16, fam, None)
+            g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
+                             "rules")
+            return jnp.where(g < BIG, g, -1)
+        q_specs = (P("batch", None), P("batch"), P())
+
+    in_specs = (
+        {k: P("rules", *([None] * (nd - 1)))  # stacked ndims
+         for k, nd in table_keys_ndim.items()},
+    ) + q_specs
+    return jax.jit(_shard_map(body, mesh, in_specs, P("batch")))
+
+
 def make_sharded_classify(mesh: Mesh, hint_stab, route_stab, acl_stab,
                           example_hq: dict):
     """-> jitted fn(ht, rt, at, hq, a16, fam, port) -> [B, 3] i32 global

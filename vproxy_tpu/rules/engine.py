@@ -14,6 +14,14 @@ the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
 * "jax-dense" — the dense matmul kernels (ops/matchers): O(rules) MXU
                 work per query; kept as the brute-force cross-check and
                 for rule-axis mesh sharding experiments.
+* "jax-sharded" — the cuckoo-hash kernels SPMD over a (batch, rules)
+                device mesh (parallel/mesh): each device holds a
+                contiguous rule slice compiled into its own table and
+                the winner rides pmax/pmin ICI collectives. Rule
+                updates reuse caps (same shapes, no retrace); an update
+                that outgrows the caps (ops.hashmatch.CapsExceeded)
+                transparently rebuilds tables — the jitted fn simply
+                retraces on the new shapes.
 
 Rule updates never retrace: tables are fixed-capacity (padded), and an
 update recompiles numpy arrays and re-uploads same-shape buffers (the
@@ -40,6 +48,31 @@ def default_backend() -> str:
     return os.environ.get("VPROXY_TPU_MATCHER", "jax")
 
 
+_MESH = None
+
+
+def default_mesh():
+    """Process-wide (batch, rules) mesh for jax-sharded matchers; batch
+    axis size from VPROXY_TPU_MESH_BATCH (default 1 = rules-only)."""
+    global _MESH
+    if _MESH is None:
+        from ..parallel import mesh as M
+        _MESH = M.make_mesh(
+            batch=int(os.environ.get("VPROXY_TPU_MESH_BATCH", "1")))
+    return _MESH
+
+
+def pad_batch(n: int, mult: int = 1, lo: int = 16) -> int:
+    """Batch-shape bucket: pow2 growth from `lo`, rounded up to a
+    multiple of `mult` (the mesh batch-axis size, so the axis always
+    divides the padded batch). ClassifyService uses the same buckets
+    (mult=1) so the jitted matchers see few trace shapes."""
+    c = lo
+    while c < n:
+        c <<= 1
+    return -(-c // mult) * mult
+
+
 # Below this rule count, single (unbatched) queries run on the host oracle:
 # a python scan over a handful of rules is ~1us while a device dispatch is
 # ~1ms — the device path wins only for big tables or batched queries. The
@@ -63,12 +96,14 @@ class HintMatcher:
     """Device-backed (or host-fallback) Upstream/DNS hint matcher."""
 
     def __init__(self, rules: Sequence[HintRule] = (), backend: Optional[str] = None,
-                 payload=None):
+                 payload=None, mesh=None):
         self.backend = backend or default_backend()
         self._rules: list[HintRule] = list(rules)
         self._dev: Optional[dict] = None
         self._tab = None  # hash-path table meta
         self._caps: Optional[dict] = None
+        self._mesh = mesh  # jax-sharded only (lazily defaulted)
+        self._fn = None    # jax-sharded jitted matcher (shape-agnostic)
         # (tab, dev, rules, payload) published as ONE tuple so concurrent
         # readers (the ClassifyService dispatcher) never see a torn
         # table/rule/payload version across a set_rules() swap; `payload`
@@ -93,6 +128,20 @@ class HintMatcher:
             self._tab = H.compile_hint_hash(self._rules, caps=self._caps)
             self._caps = self._tab.caps
             self._dev = _to_device(self._tab.arrays)
+        elif self.backend == "jax-sharded":
+            from ..parallel import mesh as M
+            if self._mesh is None:
+                self._mesh = default_mesh()
+            shards = self._mesh.shape["rules"]
+            try:
+                self._tab = H.compile_hint_hash_sharded(
+                    self._rules, shards, caps=self._caps)
+            except H.CapsExceeded:
+                # update outgrew the reused shapes: transparent rebuild
+                # (the jitted fn retraces on the new shapes)
+                self._tab = H.compile_hint_hash_sharded(self._rules, shards)
+            self._caps = self._tab.shards[0].caps
+            self._dev = M.shard_hash_table(self._tab, self._mesh)
         elif self.backend == "jax-dense":
             cap = self._dev["active"].shape[0] if self._dev is not None else None
             if cap is not None and len(self._rules) > cap:
@@ -154,6 +203,19 @@ class HintMatcher:
             q = H.encode_hint_queries(hints, tab)
             idx, _ = H.hint_hash_jit(dev, q)
             return idx
+        if self.backend == "jax-sharded":
+            from ..parallel import mesh as M
+            n = len(hints)
+            cap = pad_batch(n, self._mesh.shape["batch"])
+            padded = list(hints) + [Hint()] * (cap - n)
+            q = H.encode_hint_queries_sharded(padded, tab)
+            qd = M.shard_hint_queries_sharded(q, self._mesh)
+            if self._fn is None:
+                self._fn = M.make_sharded_hint_fn(
+                    self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
+                    {k: v.ndim for k, v in q.items()})
+            out = self._fn(dev, qd, np.int32(tab.shard_size))
+            return np.asarray(out)[:n]
         q = T.encode_hints(hints)
         idx, _ = hint_match_jit(
             dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
@@ -165,15 +227,19 @@ class CidrMatcher:
     """Device-backed ordered first-match CIDR matcher (routes / ACL)."""
 
     def __init__(self, networks: Sequence = (), backend: Optional[str] = None,
-                 acl: Optional[Sequence[AclRule]] = None, payload=None):
+                 acl: Optional[Sequence[AclRule]] = None, payload=None,
+                 mesh=None):
         self.backend = backend or default_backend()
         self._nets = list(networks)
         self._acl = list(acl) if acl is not None else None
         self._dev: Optional[dict] = None
         self._caps: Optional[dict] = None
-        # (dev, nets, acl, payload) — one atomic generation (see
+        self._tab = None   # jax-sharded stacked table meta
+        self._mesh = mesh  # jax-sharded only (lazily defaulted)
+        self._fns: dict = {}  # jax-sharded jitted fns keyed by with_port
+        # (dev, nets, acl, payload[, tab]) — one atomic generation (see
         # HintMatcher._pub for the why)
-        self._pub: tuple = (None, [], None, payload)
+        self._pub: tuple = (None, [], None, payload, None)
         self._payload = payload
         self._recompile()
 
@@ -189,6 +255,20 @@ class CidrMatcher:
             tab = H.compile_cidr_hash(self._nets, acl=self._acl, caps=self._caps)
             self._caps = tab.caps
             self._dev = _to_device(tab.arrays)
+        elif self.backend == "jax-sharded":
+            from ..parallel import mesh as M
+            if self._mesh is None:
+                self._mesh = default_mesh()
+            shards = self._mesh.shape["rules"]
+            try:
+                self._tab = H.compile_cidr_hash_sharded(
+                    self._nets, shards, acl=self._acl, caps=self._caps)
+            except H.CapsExceeded:
+                # update outgrew the reused shapes: transparent rebuild
+                self._tab = H.compile_cidr_hash_sharded(
+                    self._nets, shards, acl=self._acl)
+            self._caps = self._tab.shards[0].caps
+            self._dev = M.shard_hash_table(self._tab, self._mesh)
         elif self.backend == "jax-dense":
             cap = self._dev["allow"].shape[0] if self._dev is not None else None
             if cap is not None and len(self._nets) > cap:
@@ -197,7 +277,7 @@ class CidrMatcher:
             self._dev = _to_device(table_arrays(tab))
         self._pub = (self._dev, list(self._nets),
                      None if self._acl is None else list(self._acl),
-                     self._payload)
+                     self._payload, self._tab)
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
@@ -236,7 +316,7 @@ class CidrMatcher:
 
     def oracle_snap(self, snap: tuple, addr: bytes,
                     port: Optional[int] = None) -> int:
-        _, nets, acl, _ = snap
+        nets, acl = snap[1], snap[2]
         for j, net in enumerate(nets):
             if net.contains_ip(addr) and (
                     port is None or acl is None or
@@ -248,7 +328,7 @@ class CidrMatcher:
                       ports: Optional[Sequence[int]]):
         """Encode + submit one batch against the snapshotted table
         generation (async device result; np.asarray() it to block)."""
-        dev, nets, acl, _ = snap
+        dev, nets, acl = snap[0], snap[1], snap[2]
         if not nets or not addrs:
             return np.full(len(addrs), -1, np.int32)
         a16, fam = T.encode_ips(addrs)
@@ -258,4 +338,30 @@ class CidrMatcher:
             else np.asarray(ports, np.int32)
         if self.backend == "jax":
             return H.cidr_hash_jit(dev, a16, fam, p)
+        if self.backend == "jax-sharded":
+            return self._dispatch_sharded(snap, a16, fam, p)
         return cidr_match_jit(dev, a16, fam, p)
+
+    def _dispatch_sharded(self, snap: tuple, a16: np.ndarray,
+                          fam: np.ndarray, p: Optional[np.ndarray]):
+        from ..parallel import mesh as M
+        dev, tab = snap[0], snap[4]
+        n = a16.shape[0]
+        cap = pad_batch(n, self._mesh.shape["batch"])
+        if cap != n:
+            a16 = np.concatenate(
+                [a16, np.zeros((cap - n,) + a16.shape[1:], a16.dtype)])
+            fam = np.concatenate([fam, np.zeros(cap - n, fam.dtype)])
+            if p is not None:
+                p = np.concatenate([p, np.zeros(cap - n, p.dtype)])
+        a16d, famd, pd = M.shard_addr_queries(a16, fam, self._mesh, p)
+        with_port = p is not None
+        fn = self._fns.get(with_port)
+        if fn is None:
+            fn = self._fns[with_port] = M.make_sharded_cidr_fn(
+                self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
+                with_port)
+        size = np.int32(tab.shard_size)
+        out = fn(dev, a16d, famd, pd, size) if with_port \
+            else fn(dev, a16d, famd, size)
+        return np.asarray(out)[:n]

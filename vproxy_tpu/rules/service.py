@@ -24,6 +24,21 @@ Dispatch-path policy (mode = VPROXY_TPU_CLASSIFY, default "auto"):
              benchmarks to force the TPU path end-to-end).
 * "host"   — pure oracle (latency floor; also the correctness baseline).
 
+Latency budget (VPROXY_TPU_CLASSIFY_BUDGET_US, default 5000; 0 = off):
+in "auto" mode a LONE query against a big table normally rides the
+device and eats a full device round trip on the accept path. With a
+budget set, the service tracks per-path EWMA latencies for lone queries
+(device dispatch vs host-oracle scan) and routes lone queries to the
+oracle when the device round trip exceeds the budget and the oracle is
+faster; the device is re-probed every PROBE_EVERY-th lone query so the
+EWMA tracks tunnel/device conditions. Micro-batches (n >= 2) always
+ride the device — batching is the whole point.
+
+Every delivered query also records submit->delivery latency into a
+fixed reservoir; stats.latency_percentiles() surfaces p50/p99 (the
+BASELINE "p99 classify latency" contract, measured at the service
+boundary).
+
 Failure containment: if a device dispatch raises (TPU tunnel drop — a
 demonstrated mode in this environment), the service logs one alarm,
 serves that batch and everything after it from the host oracle, and
@@ -47,28 +62,25 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from ..utils.log import Logger
-from .engine import SMALL_TABLE
+from .engine import SMALL_TABLE, pad_batch
 from .ir import Hint
 
 _log = Logger("classify")
 
 RETRY_S = float(os.environ.get("VPROXY_TPU_DEVICE_RETRY_S", "5"))
-
-
-def _pad_pow2(n: int, lo: int = 16) -> int:
-    c = lo
-    while c < n:
-        c <<= 1
-    return c
+BUDGET_US = float(os.environ.get("VPROXY_TPU_CLASSIFY_BUDGET_US", "5000"))
+PROBE_EVERY = 32     # re-probe the non-preferred lone-query path
+LAT_RESERVOIR = 4096  # submit->delivery latency samples kept
 
 
 class _Req:
-    __slots__ = ("payload", "cb", "loop")
+    __slots__ = ("payload", "cb", "loop", "t0")
 
     def __init__(self, payload, cb, loop):
         self.payload = payload
         self.cb = cb
         self.loop = loop
+        self.t0 = time.monotonic()
 
 
 class ClassifyStats:
@@ -81,11 +93,34 @@ class ClassifyStats:
         self.oracle_queries = 0   # queries answered by the host oracle
         self.failovers = 0        # device errors that degraded a batch
         self.max_batch = 0
+        self.budget_reroutes = 0  # lone queries sent to oracle by budget
+        # submit->delivery latency reservoir (dispatcher-thread writes)
+        self._lat = np.zeros(LAT_RESERVOIR, np.float64)
+        self._lat_n = 0
+
+    def record_latency(self, seconds: float) -> None:
+        self._lat[self._lat_n % LAT_RESERVOIR] = seconds
+        self._lat_n += 1
+
+    def latency_percentiles(self) -> Optional[dict]:
+        """p50/p99 submit->delivery latency in us over the reservoir."""
+        n = min(self._lat_n, LAT_RESERVOIR)
+        if n == 0:
+            return None
+        w = self._lat[:n] * 1e6
+        return {"n": self._lat_n,
+                "p50_us": float(np.percentile(w, 50)),
+                "p99_us": float(np.percentile(w, 99))}
 
     def snapshot(self) -> dict:
-        return {k: getattr(self, k) for k in (
+        d = {k: getattr(self, k) for k in (
             "queries", "dispatches", "device_queries", "oracle_queries",
-            "failovers", "max_batch")}
+            "failovers", "max_batch", "budget_reroutes")}
+        lat = self.latency_percentiles()
+        if lat is not None:
+            d["latency_p50_us"] = round(lat["p50_us"], 1)
+            d["latency_p99_us"] = round(lat["p99_us"], 1)
+        return d
 
 
 class ClassifyService:
@@ -110,6 +145,10 @@ class ClassifyService:
     def __init__(self, mode: Optional[str] = None):
         self.mode = mode or os.environ.get("VPROXY_TPU_CLASSIFY", "auto")
         self.retry_s = RETRY_S
+        self.budget_us = BUDGET_US
+        # lone-query EWMA latency (us) per path, None until first sample
+        self._ewma = {"device": None, "oracle": None}
+        self._lone_seen = 0
         self.stats = ClassifyStats()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -187,7 +226,38 @@ class ClassifyService:
             return True
         # auto: micro-batches always ride the device; lone queries only
         # when the table is past the oracle's crossover size
-        return n >= 2 or matcher.size() > SMALL_TABLE
+        if n >= 2:
+            return True
+        if matcher.size() <= SMALL_TABLE:
+            return False
+        return self._lone_path_is_device()
+
+    def _lone_path_is_device(self) -> bool:
+        """Budget policy for a lone query against a big table: prefer the
+        device, but when its measured round trip blows the latency budget
+        and the host oracle is faster, reroute. Either path is re-probed
+        periodically so the EWMAs track current conditions."""
+        if self.budget_us <= 0:
+            return True
+        self._lone_seen += 1
+        dev, orc = self._ewma["device"], self._ewma["oracle"]
+        if dev is None:
+            return True           # first lone query: measure the device
+        if dev <= self.budget_us:
+            return True           # device round trip within budget
+        # over budget: prefer the faster path, but flip to the other one
+        # every PROBE_EVERY-th query so a stale EWMA can't pin the choice
+        prefer_dev = orc is not None and dev <= orc
+        if self._lone_seen % PROBE_EVERY == 0:
+            return not prefer_dev
+        if not prefer_dev:
+            self.stats.budget_reroutes += 1
+        return prefer_dev
+
+    def _note_lone_latency(self, path: str, seconds: float) -> None:
+        us = seconds * 1e6
+        cur = self._ewma[path]
+        self._ewma[path] = us if cur is None else 0.8 * cur + 0.2 * us
 
     def _dispatch(self, kind: str, matcher, reqs: list[_Req]) -> None:
         if kind == "cidr":
@@ -206,10 +276,14 @@ class ClassifyService:
         n = len(reqs)
         self.stats.max_batch = max(self.stats.max_batch, n)
         snap = matcher.snapshot()  # ONE generation for device/oracle/payload
+        lone_big = n == 1 and matcher.size() > SMALL_TABLE
         idxs = None
         if self._use_device(matcher, n):
             try:
+                t0 = time.monotonic()
                 idxs = self._device_batch(kind, matcher, snap, reqs)
+                if lone_big:
+                    self._note_lone_latency("device", time.monotonic() - t0)
                 self.stats.dispatches += 1
                 self.stats.device_queries += n
             except Exception as e:
@@ -218,13 +292,16 @@ class ClassifyService:
                 _log.alert(f"device classify failed ({e!r}); serving from "
                            f"host oracle, retry in {self.retry_s:.0f}s")
         if idxs is None:
+            t0 = time.monotonic()
             idxs = self._oracle_batch(kind, matcher, snap, reqs)
+            if lone_big:
+                self._note_lone_latency("oracle", time.monotonic() - t0)
             self.stats.oracle_queries += n
         self._deliver(reqs, idxs, matcher.snap_payload(snap))
 
     def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
         n = len(reqs)
-        cap = _pad_pow2(n)
+        cap = pad_batch(n)
         if kind == "hint":
             hints = [r.payload for r in reqs]
             hints += [Hint()] * (cap - n)
@@ -251,7 +328,9 @@ class ClassifyService:
         (None when the owner didn't register one). Callbacks run on the
         submitting loop; if that loop is gone, inline on this thread so
         cleanup (closing an accepted fd) still happens."""
+        now = time.monotonic()
         for r, idx in zip(reqs, idxs):
+            self.stats.record_latency(now - r.t0)
             i = int(idx)
 
             def run(cb=r.cb, i=i) -> None:
