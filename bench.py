@@ -6,6 +6,19 @@ latency < 50us. A "rule-match" is one query classified against a full
 table (the reference does this with a linear Java scan per connection:
 Upstream.java:187, RouteTable.java:44, SecurityGroup.java:30).
 
+The TPU in this environment sits behind a tunnel with ~65ms per-dispatch
+round trip and ~0.7 MB/s d2h (measured, r3). The headline section
+therefore amortizes the RPC with DEVICE-SIDE MULTI-STEP EXECUTION: one
+jitted `lax.fori_loop` classifies K pre-uploaded query batches per
+dispatch and returns only [K] u32 verdict checksums (K*4 bytes d2h), so
+one ~65ms round trip buys K*B queries. Verdicts stay on device — which
+is also the production shape: the consumer of a verdict (routing
+decision feeding a device-resident table, or a host that reads back
+per-CONNECTION results far smaller than per-query batches) does not pay
+per-query d2h. The e2e section then measures the OTHER contract — full
+[B,2] verdict readback per dispatch — and reports the measured tunnel
+ceiling (d2h_MBps / 2 bytes-per-verdict) beside it, honestly.
+
 Staged orchestration (each stage is its own child process so a hung TPU
 tunnel cannot eat the whole budget, and every stage leaves per-phase
 timing evidence behind even when killed):
@@ -16,19 +29,28 @@ timing evidence behind even when killed):
      passed, within the remaining budget.
   3. cpu       — evidence-of-life fallback only if no TPU stage landed.
 
-Each child appends one JSON line per completed phase to
-BENCH_PHASE_FILE; the final stdout JSON embeds the phase evidence, so a
-timeout still tells you WHERE the time went.
+Children are ADAPTIVE: each measured section times one dispatch first
+and sizes its iteration count to a deadline derived from
+BENCH_CHILD_BUDGET, and the result file is rewritten after EVERY
+section, so a SIGTERM mid-stage still leaves the sections that finished
+(the orchestrator accepts partial results). Compilations go through a
+persistent cache (.jax_cache/) so repeated runs skip the 14-25s
+warmup_compile cost.
 
 Measured sections per child:
-  * throughput — async pipelined steady state: per step run the fused
-    hint+LPM+ACL classify over a PRE-UPLOADED query batch (no h2d on
-    the critical path), chunked async d2h readback.
-  * latency — per-dispatch submit->verdict-on-host p50/p99, measured
-    blocking (batch=1 and batch=LAT_BATCH), steady state.
-  * service — ClassifyService accept->verdict latency under synthetic
-    multi-threaded connection load (the BASELINE contract measured at
-    the service boundary).
+  * throughput_device — the headline: pipelined multi-step dispatches,
+    kernel-resident verdicts, checksum readback. Also yields
+    kernel_step_us = dispatch_time / K.
+  * throughput_e2e — single-step dispatches with full [B,2] verdict
+    readback (chunked, async) — the end-to-end number, bounded by the
+    tunnel; reported with the measured ceiling.
+  * latency_b1 / latency_bN — per-dispatch submit->verdict-on-host
+    p50/p99, measured blocking, steady state.
+  * service — ClassifyService accept->verdict under synthetic load,
+    BOTH contracts: mode=device (raw device round trip at the service
+    boundary) and mode=auto with the latency budget policy (lone
+    queries ride the host oracle when the device blows the budget —
+    the accept-path p99 story).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -46,6 +68,10 @@ TARGET = 10_000_000.0  # rule-matches/sec north star
 
 def _env_int(k, d):
     return int(os.environ.get(k, str(d)))
+
+
+def _env_float(k, d):
+    return float(os.environ.get(k, str(d)))
 
 
 # ----------------------------------------------------------------- phases
@@ -150,20 +176,67 @@ def build(ph):
         a16, fam = T.encode_ips(addrs)
         ports = rs.randint(1, 65535, size=batch).astype(np.int32)
         qsets.append((hq, a16, fam, ports))
+
+    # unify the host-probe tier across sets so they stack on one axis
+    maxp = max(q[0]["hp_len"].shape[1] for q in qsets)
+    for hq, _, _, _ in qsets:
+        cur = hq["hp_len"].shape[1]
+        if cur < maxp:
+            pad = np.full((batch, maxp - cur), -1, np.int32)
+            for k in ("hp_len", "hp_slot1", "hp_slot2"):
+                hq[k] = np.concatenate([hq[k], pad], axis=1)
     ph.done(batch=batch, sets=nq)
     return ht, rt, at, hint_group, route_tgt, qsets
 
 
 # ------------------------------------------------------------------ child
 
+def _enable_compile_cache(here):
+    """Persistent XLA compilation cache: repeated runs (same shapes) skip
+    the 14-25s trace+compile entirely. Best-effort — an axon/plugin
+    backend that cannot serialize executables just misses the cache."""
+    import jax
+    cache = os.environ.get("BENCH_COMPILE_CACHE",
+                           os.path.join(here, ".jax_cache"))
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return True
+    except Exception:
+        return False
+
+
+class Deadline:
+    """Child-side budget: sections size their iteration counts to what is
+    left so the child exits cleanly instead of being SIGTERMed."""
+
+    def __init__(self, budget_s):
+        self.t0 = time.time()
+        self.budget = budget_s
+
+    def remaining(self):
+        return self.budget - (time.time() - self.t0)
+
+    def iters(self, t_each, target_frac, lo=3, hi=4096, reserve=10.0):
+        avail = max(0.0, (self.remaining() - reserve) * target_frac)
+        if t_each <= 0:
+            return hi
+        return int(max(lo, min(hi, avail / t_each)))
+
+
 def child():
     stage = os.environ.get("BENCH_STAGE", "child")
     ph = Phases(os.environ.get("BENCH_PHASE_FILE", ""), stage)
+    here = os.path.dirname(os.path.abspath(__file__))
+    dl = Deadline(_env_float("BENCH_CHILD_BUDGET", 600.0))
 
     ph.start("import_jax")
+    cache_ok = _enable_compile_cache(here)
     import jax
     import jax.numpy as jnp
-    ph.done()
+    ph.done(compile_cache=cache_ok)
 
     ph.start("devices")
     dev = jax.devices()[0]
@@ -177,22 +250,42 @@ def child():
     n_nexthop = _env_int("BENCH_NEXTHOPS", 120)
     assert n_groups < 255 and n_nexthop < 127, "u8 verdict packing bounds"
     batch = _env_int("BENCH_BATCH", 16384)
-    iters = _env_int("BENCH_ITERS", 256)
-    chunk = _env_int("BENCH_CHUNK", 64)
+    ksteps = _env_int("BENCH_STEPS_PER_DISPATCH", 512)
+
+    nr = _env_int("BENCH_RULES", 100000)
+    label = "%dk" % (nr // 1000) if nr >= 1000 else str(nr)
+    result = {
+        "metric": "rule-matches/sec @%s rules (Host+DNS hints, LPM, ACL)"
+                  % label,
+        "value": 0.0, "unit": "matches/s", "vs_baseline": 0.0,
+        "platform": platform, "stage": stage, "partial": True,
+    }
+    result_file = os.environ.get("BENCH_RESULT_FILE")
+
+    def flush():
+        if result_file:
+            with open(result_file + ".tmp", "w") as f:
+                json.dump(result, f)
+            os.replace(result_file + ".tmp", result_file)
 
     ht, rt, at, hint_group, route_tgt, qsets = build(ph)
 
     # h2d/d2h bandwidth probe: says whether a later stall is the tunnel
     ph.start("bw_probe")
-    mb8 = np.ones((8 << 20,), np.uint8)
+    mb8 = np.ones((4 << 20,), np.uint8)
     t0 = time.time()
     x = jax.device_put(mb8)
-    x.block_until_ready()
-    h2d = 8.0 / max(time.time() - t0, 1e-9)
+    np.asarray(x[:1])  # real sync: block_until_ready lies on axon
+    h2d = 4.0 / max(time.time() - t0, 1e-9)
     t0 = time.time()
-    np.asarray(x[: 1 << 20])
-    d2h = 1.0 / max(time.time() - t0, 1e-9)
+    np.asarray(x[: 256 << 10])
+    d2h = 0.25 / max(time.time() - t0, 1e-9)
     ph.done(h2d_MBps=round(h2d, 1), d2h_MBps=round(d2h, 1))
+    result["h2d_MBps"] = round(h2d, 1)
+    result["d2h_MBps"] = round(d2h, 1)
+    # full-verdict-readback ceiling in the headline's unit (matches/s):
+    # 2 bytes of verdict buy 3 rule-matches per query over the d2h path
+    result["tunnel_ceiling_matches_s"] = round(d2h * 1e6 / 2.0 * 3.0, 1)
 
     ph.start("upload_tables")
     htd, rtd, atd = (_to_device(ht.arrays), _to_device(rt.arrays),
@@ -201,18 +294,22 @@ def child():
     jax.block_until_ready([htd, rtd, atd, hgd, rtgd])
     ph.done()
 
-    # pre-upload every query set ONCE — steady state has no h2d at all
+    # pre-upload every query set ONCE — steady state has no h2d at all.
+    # Sets are STACKED on a leading axis so the device-side loop can
+    # index them with the iteration counter.
     ph.start("upload_queries")
-    dsets = []
-    for hq, a16, fam, ports in qsets:
-        dsets.append(({k: jax.device_put(v) for k, v in hq.items()},
-                      jax.device_put(a16), jax.device_put(fam),
-                      jax.device_put(ports)))
-    jax.block_until_ready(dsets)
+    nq = len(qsets)
+    hq_stack = {k: jax.device_put(np.stack([q[0][k] for q in qsets]))
+                for k in qsets[0][0]}
+    a16s = jax.device_put(np.stack([q[1] for q in qsets]))
+    fams = jax.device_put(np.stack([q[2] for q in qsets]))
+    portss = jax.device_put(np.stack([q[3] for q in qsets]))
+    dsets = [({k: v[s] for k, v in hq_stack.items()},
+              a16s[s], fams[s], portss[s]) for s in range(nq)]
+    jax.block_until_ready([hq_stack, a16s, fams, portss])
     ph.done()
 
-    @jax.jit
-    def step_fn(ht_, rt_, at_, hg_, rtg_, hq, a16, fam, port):
+    def _verdict(ht_, rt_, at_, hg_, rtg_, hq, a16, fam, port):
         hi, _ = hint_hash_match(ht_, hq)
         ri = cidr_hash_match(rt_, a16, fam, None)
         ai = cidr_hash_match(at_, a16, fam, port)
@@ -222,97 +319,175 @@ def child():
         v1 = (allow.astype(jnp.uint8) << 7) | tgt.astype(jnp.uint8)
         return jnp.stack([group.astype(jnp.uint8), v1], axis=1)  # [B,2] u8
 
+    @jax.jit
+    def step_fn(ht_, rt_, at_, hg_, rtg_, hq, a16, fam, port):
+        return _verdict(ht_, rt_, at_, hg_, rtg_, hq, a16, fam, port)
+
+    @jax.jit
+    def multi_fn(ht_, rt_, at_, hg_, rtg_, hqs, a16s_, fams_, portss_):
+        """K classify steps per dispatch, verdicts reduced on device to
+        [K] u32 checksums (K*4 bytes d2h). Each iteration classifies the
+        full batch of query set i%S with ports rotated by i, so no two
+        iterations are loop-invariant and checksum[0] is reproducible by
+        step_fn on set 0 (verified below)."""
+        s_count = fams_.shape[0]
+
+        def body(i, acc):
+            s = i % s_count
+            hq = {k: v[s] for k, v in hqs.items()}
+            port = (portss_[s] + i) % 65536
+            v = _verdict(ht_, rt_, at_, hg_, rtg_, hq,
+                         a16s_[s], fams_[s], port)
+            return acc.at[i].set(jnp.sum(v.astype(jnp.uint32)))
+
+        return jax.lax.fori_loop(0, ksteps, body,
+                                 jnp.zeros(ksteps, jnp.uint32))
+
     def submit(ds):
         hq, a16, fam, ports = ds
         return step_fn(htd, rtd, atd, hgd, rtgd, hq, a16, fam, ports)
 
-    ph.start("warmup_compile")
-    np.asarray(submit(dsets[0]))
-    ph.done()
+    def submit_multi():
+        return multi_fn(htd, rtd, atd, hgd, rtgd,
+                        hq_stack, a16s, fams, portss)
 
-    # ---- throughput: async pipeline, chunked d2h off the critical path
-    ph.start("throughput")
-    nq = len(dsets)
-    pending, cur = [], []
+    ph.start("warmup_compile")
+    first = np.asarray(submit(dsets[0]))
+    t_multi_c = time.time()
+    chks = np.asarray(submit_multi())
+    compile_s = ph.done(multi_extra_s=round(time.time() - t_multi_c, 2))
+    result["compile_s"] = round(compile_s, 2)
+
+    # verify the device loop agrees with the single-step kernel
+    ph.start("verify_checksum")
+    chk_host = int(first.astype(np.uint32).sum())
+    chk_ok = int(chks[0]) == chk_host
+    ph.done(chk_ok=chk_ok, device=int(chks[0]), host=chk_host)
+    result["chk_ok"] = bool(chk_ok)
+    flush()
+
+    # ---- headline: device-side multi-step, checksum readback only.
+    # MEASUREMENT NOTE (discovered r4): on the axon tunnel backend,
+    # block_until_ready() is NOT a true barrier — it can return before
+    # remote execution finishes. Every timing boundary here therefore
+    # syncs with a real d2h pull (np.asarray), and the final pull of the
+    # stacked [iters, K] checksums (a few KB) is INSIDE the timed span.
+    ph.start("throughput_device")
+    t0 = time.time()
+    np.asarray(submit_multi())
+    t_one = time.time() - t0
+    iters = dl.iters(t_one, 0.35, lo=3,
+                     hi=_env_int("BENCH_ITERS", 4096))
+    outs = []
+    t0 = time.time()
+    for _ in range(iters):
+        outs.append(submit_multi())
+    # pull each [K] checksum directly — a jnp.stack here would compile a
+    # fresh concatenate program (iters varies run to run) inside the
+    # timed span; pulls are a few KB total
+    all_chk = np.stack([np.asarray(o) for o in outs])
+    total = time.time() - t0
+    assert all_chk.shape == (iters, ksteps)
+    matches = 3 * batch * ksteps * iters  # hint + route + acl per element
+    rate = matches / total
+    dispatch_us = total / iters * 1e6
+    kernel_step_us = dispatch_us / ksteps
+    ph.done(rate=round(rate, 1), iters=iters, k=ksteps,
+            dispatch_us=round(dispatch_us, 1),
+            kernel_step_us=round(kernel_step_us, 1))
+    result.update({
+        "value": round(rate, 1),
+        "vs_baseline": round(rate / TARGET, 4),
+        "steps_per_dispatch": ksteps,
+        "dispatch_us": round(dispatch_us, 1),
+        "kernel_step_us": round(kernel_step_us, 1),
+        "kernel_matches_s": round(
+            3 * batch / max(kernel_step_us, 1e-9) * 1e6, 1),
+    })
+    flush()
+
+    # ---- e2e: full [B,2] verdict readback per dispatch (tunnel-bound)
+    ph.start("throughput_e2e")
+    t0 = time.time()
+    np.asarray(submit(dsets[0]))
+    t_one = time.time() - t0
+    e2e_iters = dl.iters(t_one, 0.25, lo=3,
+                         hi=_env_int("BENCH_E2E_ITERS", 256))
+    pending = []
     done = 0
     t0 = time.time()
-    for i in range(iters):
-        cur.append(submit(dsets[i % nq]))
-        if len(cur) == chunk:
-            arr = jnp.stack(cur)
-            arr.copy_to_host_async()
-            pending.append(arr)
-            cur = []
-            while len(pending) > 2:  # keep readback off the critical path
-                r = np.asarray(pending.pop(0))
-                done += r.shape[0] * r.shape[1]
-    if cur:
-        arr = jnp.stack(cur)
+    for i in range(e2e_iters):
+        arr = submit(dsets[i % nq])
         arr.copy_to_host_async()
         pending.append(arr)
+        while len(pending) > 2:
+            r = np.asarray(pending.pop(0))
+            done += r.shape[0]
     for p in pending:
         r = np.asarray(p)
-        done += r.shape[0] * r.shape[1]
+        done += r.shape[0]
     total = time.time() - t0
-    assert done == iters * batch
-    matches = 3 * batch * iters  # hint + route + acl per element
-    rate = matches / total
-    step_us = total / iters * 1e6
-    ph.done(rate=round(rate, 1), step_us=round(step_us, 1))
+    assert done == e2e_iters * batch
+    e2e_rate = 3 * batch * e2e_iters / total
+    e2e_step_us = total / e2e_iters * 1e6
+    ph.done(rate=round(e2e_rate, 1), iters=e2e_iters,
+            step_us=round(e2e_step_us, 1))
+    result["e2e_rate"] = round(e2e_rate, 1)
+    result["e2e_step_us"] = round(e2e_step_us, 1)
+    result["step_us"] = round(e2e_step_us, 1)
+    flush()
 
     # ---- latency: per-dispatch submit->verdict-on-host, steady state
-    lat_iters = _env_int("BENCH_LAT_ITERS", 100)
     lat_batch = _env_int("BENCH_LAT_BATCH", 256)
     lat = {}
-    for b in (1, lat_batch):
+    for b, frac in ((1, 0.25), (lat_batch, 0.3)):
+        if dl.remaining() < 45:
+            break
         ph.start(f"latency_b{b}")
         small = tuple(
             {k: v[:b] for k, v in ds.items()} if isinstance(ds, dict)
             else ds[:b] for ds in dsets[0])
-        np.asarray(submit(small))  # warm this shape
+        t0 = time.time()
+        np.asarray(submit(small))  # warm this shape (compile)
+        t_one = max(time.time() - t0, 1e-4)
+        n_iter = dl.iters(min(t_one, 0.2), frac, lo=10,
+                          hi=_env_int("BENCH_LAT_ITERS", 100))
         samples = []
-        for _ in range(lat_iters):
+        for _ in range(n_iter):
             t0 = time.time()
             np.asarray(submit(small))
             samples.append(time.time() - t0)
         lat[b] = (float(np.percentile(samples, 50) * 1e6),
                   float(np.percentile(samples, 99) * 1e6))
-        ph.done(p50_us=round(lat[b][0], 1), p99_us=round(lat[b][1], 1))
+        ph.done(p50_us=round(lat[b][0], 1), p99_us=round(lat[b][1], 1),
+                iters=n_iter)
+        result["dispatch_p50_us" if b == 1 else
+               "dispatch_b%d_p50_us" % b] = round(lat[b][0], 1)
+        result["dispatch_p99_us" if b == 1 else
+               "dispatch_b%d_p99_us" % b] = round(lat[b][1], 1)
+        flush()
 
     # ---- ClassifyService accept->verdict under synthetic load
-    svc_stats = service_section(ph)
+    if dl.remaining() > 40:
+        result.update(service_section(ph, dl))
+        flush()
 
-    nr = _env_int("BENCH_RULES", 100000)
-    label = "%dk" % (nr // 1000) if nr >= 1000 else str(nr)
-    result = {
-        "metric": "rule-matches/sec @%s rules (Host+DNS hints, LPM, ACL)"
-                  % label,
-        "value": round(rate, 1),
-        "unit": "matches/s",
-        "vs_baseline": round(rate / TARGET, 4),
-        "platform": platform,
-        "stage": stage,
-        "step_us": round(step_us, 1),
-        "dispatch_p50_us": round(lat[1][0], 1),
-        "dispatch_p99_us": round(lat[1][1], 1),
-        "dispatch_b%d_p50_us" % lat_batch: round(lat[lat_batch][0], 1),
-        "dispatch_b%d_p99_us" % lat_batch: round(lat[lat_batch][1], 1),
-    }
-    result.update(svc_stats)
-    out = os.environ.get("BENCH_RESULT_FILE")
-    if out:
-        with open(out, "w") as f:
-            json.dump(result, f)
+    result["partial"] = False
+    flush()
     print(json.dumps(result))
     return 0
 
 
-def service_section(ph):
-    """ClassifyService end-to-end: N threads each performing sequential
-    accept-like lone classifies + bursts, against a big HintMatcher in
-    mode=device. Reports submit->verdict-on-host percentiles measured by
-    the service's own reservoir (the BASELINE latency contract at the
-    component boundary)."""
+def service_section(ph, dl):
+    """ClassifyService end-to-end, both contracts:
+
+    * device — N threads of lone classifies + bursts with mode=device:
+      the raw submit->verdict round trip at the service boundary.
+    * policy — mode=auto with the latency budget: lone accept-path
+      queries ride the host oracle once the device EWMA blows the
+      budget (re-probing keeps the EWMA live), so the p50 shows the
+      oracle floor and the p99 shows the probe cost — the honest
+      accept-path latency story under a slow tunnel."""
     import threading
 
     from vproxy_tpu.rules.engine import HintMatcher
@@ -327,74 +502,97 @@ def service_section(ph):
     rules = [HintRule(host=f"svc{i}.bench.example.com")
              for i in range(n_rules)]
     m = HintMatcher(rules)
-    svc = ClassifyService(mode="device")
     m.match([Hint.of_host("warm.example.com")] * 16)  # warm jit
     ph.done(rules=n_rules)
 
-    ph.start("service_load")
-    errs = []
-    t_done = threading.Event()
-    remaining = [n_threads]
-    lock = threading.Lock()
+    out = {}
 
-    def worker(tid):
-        try:
-            for i in range(per):
-                ev = threading.Event()
-                want = (tid * per + i) % n_rules
+    def load(svc, tag, threads, per):
+        errs = []
+        t_done = threading.Event()
+        remaining = [threads]
+        lock = threading.Lock()
 
-                def cb(idx, _pl, want=want, ev=ev):
-                    if idx != want:
-                        errs.append((want, idx))
-                    ev.set()
+        def worker(tid):
+            try:
+                for i in range(per):
+                    ev = threading.Event()
+                    want = (tid * per + i) % n_rules
 
-                svc.submit_hint(m, Hint.of_host(
-                    f"svc{want}.bench.example.com"), cb)
-                ev.wait(30)
-        finally:
-            with lock:
-                remaining[0] -= 1
-                if remaining[0] == 0:
-                    t_done.set()
+                    def cb(idx, _pl, want=want, ev=ev):
+                        if idx != want:
+                            errs.append((want, idx))
+                        ev.set()
 
-    t0 = time.time()
-    for t in range(n_threads):
-        threading.Thread(target=worker, args=(t,), daemon=True).start()
-    t_done.wait(120)
-    wall = time.time() - t0
-    lat = svc.stats.latency_percentiles() or {"p50_us": -1, "p99_us": -1}
-    st = svc.stats
-    ph.done(queries=st.queries, dispatches=st.dispatches,
-            max_batch=st.max_batch, p50_us=round(lat["p50_us"], 1),
-            p99_us=round(lat["p99_us"], 1), wall_s=round(wall, 2),
-            errors=len(errs))
-    svc.close()
-    assert not errs, errs[:5]
-    return {"service_p50_us": round(lat["p50_us"], 1),
-            "service_p99_us": round(lat["p99_us"], 1),
-            "service_max_batch": st.max_batch,
-            "service_dispatches": st.dispatches,
-            "service_queries": st.queries}
+                    svc.submit_hint(m, Hint.of_host(
+                        f"svc{want}.bench.example.com"), cb)
+                    ev.wait(30)
+            finally:
+                with lock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        t_done.set()
+
+        t0 = time.time()
+        for t in range(threads):
+            threading.Thread(target=worker, args=(t,), daemon=True).start()
+        t_done.wait(120)
+        wall = time.time() - t0
+        lat = svc.stats.latency_percentiles() or {"p50_us": -1, "p99_us": -1}
+        st = svc.stats
+        ph.done(queries=st.queries, dispatches=st.dispatches,
+                max_batch=st.max_batch, p50_us=round(lat["p50_us"], 1),
+                p99_us=round(lat["p99_us"], 1), wall_s=round(wall, 2),
+                errors=len(errs), reroutes=st.budget_reroutes)
+        svc.close()
+        assert not errs, errs[:5]
+        out[f"service_{tag}_p50_us"] = round(lat["p50_us"], 1)
+        out[f"service_{tag}_p99_us"] = round(lat["p99_us"], 1)
+        out[f"service_{tag}_max_batch"] = st.max_batch
+        out[f"service_{tag}_dispatches"] = st.dispatches
+        out[f"service_{tag}_queries"] = st.queries
+        if tag == "policy":
+            out["service_policy_reroutes"] = st.budget_reroutes
+            out["service_policy_oracle_queries"] = st.oracle_queries
+
+    ph.start("service_device_load")
+    load(ClassifyService(mode="device"), "device", n_threads, per)
+
+    if dl.remaining() > 25:
+        # accept-path contract: sequential lone queries, budget policy on
+        ph.start("service_policy_load")
+        svc = ClassifyService(mode="auto")
+        svc.budget_us = _env_float("BENCH_SVC_BUDGET_US", 5000.0)
+        load(svc, "policy", 1, _env_int("BENCH_SVC_POLICY_QUERIES", 200))
+    # legacy field names point at the device contract
+    out["service_p50_us"] = out.get("service_device_p50_us")
+    out["service_p99_us"] = out.get("service_device_p99_us")
+    return out
 
 
 # ----------------------------------------------------------- orchestrator
 
 SMOKE_ENV = {"BENCH_RULES": "1000", "BENCH_ROUTES": "500",
              "BENCH_ACLS": "200", "BENCH_BATCH": "512",
-             "BENCH_ITERS": "16", "BENCH_CHUNK": "4",
+             "BENCH_STEPS_PER_DISPATCH": "1024",
+             "BENCH_ITERS": "32", "BENCH_E2E_ITERS": "16",
              "BENCH_QUERY_SETS": "2", "BENCH_LAT_ITERS": "32",
-             "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25"}
+             "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25",
+             "BENCH_SVC_POLICY_QUERIES": "100"}
 
-CPU_ENV = {"BENCH_ITERS": "16", "BENCH_CHUNK": "8",
+CPU_ENV = {"BENCH_ITERS": "16", "BENCH_E2E_ITERS": "8",
+           "BENCH_STEPS_PER_DISPATCH": "8",
            "BENCH_QUERY_SETS": "2", "BENCH_LAT_ITERS": "16",
-           "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25"}
+           "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25",
+           "BENCH_SVC_POLICY_QUERIES": "50"}
 
 
 def _run_stage(name, env_over, timeout, phase_file, cpu=False):
     """Run one measured child; returns its result dict or None.
-    SIGTERM first (a SIGKILLed TPU-tunnel client wedges the device pool
-    for minutes — demonstrated in this environment), SIGKILL only as a
-    last resort."""
+    Children rewrite their result file after every section, so a timed-
+    out child still contributes a partial result. SIGTERM first (a
+    SIGKILLed TPU-tunnel client wedges the device pool for minutes —
+    demonstrated in this environment), SIGKILL only as a last resort."""
     here = os.path.dirname(os.path.abspath(__file__))
     result_file = os.path.join(here, f".bench_result_{name}.json")
     if os.path.exists(result_file):
@@ -408,6 +606,7 @@ def _run_stage(name, env_over, timeout, phase_file, cpu=False):
     env["BENCH_STAGE"] = name
     env["BENCH_PHASE_FILE"] = phase_file
     env["BENCH_RESULT_FILE"] = result_file
+    env.setdefault("BENCH_CHILD_BUDGET", str(max(30.0, timeout - 15.0)))
     sys.stderr.write(f"# === stage {name} (timeout {timeout:.0f}s) ===\n")
     sys.stderr.flush()
     p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
@@ -429,9 +628,17 @@ def _run_stage(name, env_over, timeout, phase_file, cpu=False):
                 # D-state child stuck on the wedged tunnel: abandon it —
                 # the final JSON line must still be printed
                 sys.stderr.write(f"# stage {name}: unkillable, abandoned\n")
-    if p.returncode == 0 and os.path.exists(result_file):
-        with open(result_file) as f:
-            return json.load(f)
+    if os.path.exists(result_file):
+        try:
+            with open(result_file) as f:
+                res = json.load(f)
+            if res.get("partial"):
+                sys.stderr.write(f"# stage {name}: partial result "
+                                 f"(rc={p.returncode})\n")
+            res["stage_rc"] = p.returncode
+            return res
+        except ValueError:
+            pass
     sys.stderr.write(f"# stage {name}: rc={p.returncode}, no result\n")
     return None
 
@@ -459,21 +666,19 @@ def orchestrate():
     if os.path.exists(phase_file):
         os.unlink(phase_file)
     budget = float(os.environ.get("BENCH_BUDGET", "900"))
-    smoke_timeout = min(float(os.environ.get("BENCH_SMOKE_TIMEOUT", "240")),
-                        budget)
+    smoke_timeout = min(float(os.environ.get("BENCH_SMOKE_TIMEOUT", "180")),
+                        budget * 0.45)
     t_start = time.time()
 
     result = None
     smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
-    if smoke is not None and smoke.get("platform") != "cpu":
+    if smoke is not None and smoke.get("platform") != "cpu" \
+            and smoke.get("value", 0) > 0:
         result = smoke
-        remaining = budget - (time.time() - t_start)
-        if remaining > 120:
-            full = _run_stage(
-                "tpu-full",
-                {"BENCH_ITERS": "128", "BENCH_CHUNK": "32"},
-                remaining, phase_file)
-            if full is not None:
+        remaining = budget - (time.time() - t_start) - 15
+        if remaining > 90:
+            full = _run_stage("tpu-full", {}, remaining, phase_file)
+            if full is not None and full.get("value", 0) > 0:
                 result = full
     if result is None:
         # no TPU evidence: CPU evidence-of-life run (trimmed iterations;
