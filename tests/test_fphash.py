@@ -1,0 +1,224 @@
+"""Packed fingerprint kernels (ops/fphash.py) vs the pure-Python oracle.
+
+fphash re-expresses ops/hashmatch.py's semantics under the measured
+TPU cost model (one wide row gather per probe, fingerprint verification
+instead of byte compares). Every parity case the cuckoo kernels pass
+must hold here too, plus fp-specific ones: inline slot entries, member
+packing bounds, the all-V4 group slice, ACL member containment pruning.
+"""
+import random
+
+import numpy as np
+
+from vproxy_tpu.ops import fphash as F
+from vproxy_tpu.ops import tables as T
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.ir import (AclRule, Hint, HintRule, Proto, RouteRule,
+                                 RouteTable)
+from vproxy_tpu.utils.ip import Network, mask_bytes, parse_ip
+
+rnd = random.Random(4321)
+
+WORDS = ["a", "bb", "ccc", "x", "api", "web", "cdn", "img", "v2", "svc"]
+TLDS = ["com", "net", "io", "local"]
+
+
+def rand_domain():
+    n = rnd.randint(1, 3)
+    return ".".join(rnd.choice(WORDS) for _ in range(n)) + "." + rnd.choice(TLDS)
+
+
+def rand_uri():
+    n = rnd.randint(1, 4)
+    return "/" + "/".join(rnd.choice(WORDS) for _ in range(n))
+
+
+def rand_hint_rule():
+    host = uri = None
+    port = 0
+    while host is None and uri is None and port == 0:
+        if rnd.random() < 0.7:
+            host = "*" if rnd.random() < 0.1 else rand_domain()
+        if rnd.random() < 0.5:
+            uri = "*" if rnd.random() < 0.1 else rand_uri()
+        if rnd.random() < 0.3:
+            port = rnd.choice([80, 443, 8080])
+    return HintRule(host=host, port=port, uri=uri)
+
+
+def rand_hint():
+    host = rand_domain() if rnd.random() < 0.8 else None
+    if host and rnd.random() < 0.5:
+        host = rnd.choice(WORDS) + "." + host
+    uri = rand_uri() if rnd.random() < 0.6 else None
+    port = rnd.choice([0, 80, 443, 8080])
+    return Hint(host=host, port=port, uri=uri)
+
+
+def check_hints(rules, hints):
+    tab = F.compile_hint_fp(rules)
+    q = F.encode_hint_queries_fp(hints, tab)
+    idx, level = F.hint_fp_match(tab.arrays, q)
+    idx, level = np.asarray(idx), np.asarray(level)
+    for i, h in enumerate(hints):
+        want = oracle.search(rules, h)
+        assert idx[i] == want, (i, h, int(idx[i]), want,
+                                rules[idx[i]] if idx[i] >= 0 else None,
+                                rules[want] if want >= 0 else None)
+        if want >= 0:
+            assert level[i] == oracle.match_level(h, rules[want])
+
+
+def test_hint_fp_parity_random():
+    rules = [rand_hint_rule() for _ in range(300)]
+    hints = [rand_hint() for _ in range(600)]
+    for i in range(0, 200, 3):
+        r = rules[i % len(rules)]
+        if r.host and r.host != "*":
+            hints[i] = Hint(host=r.host, port=r.port or 0, uri=r.uri)
+    check_hints(rules, hints)
+
+
+def test_hint_fp_shared_keys_and_tiebreak():
+    rules = [
+        HintRule(host="a.com", uri="/x"),
+        HintRule(host="a.com", uri="/xy"),
+        HintRule(host="a.com"),
+        HintRule(host="a.com", port=443),
+        HintRule(host="a.com", uri="/xy"),  # dup of 1 — index 1 wins
+        HintRule(host="com"),  # suffix for *.com
+        HintRule(host="*", uri="/x"),
+        HintRule(uri="/xy"),  # uri-only rule
+        HintRule(uri="*"),
+    ]
+    hints = [
+        Hint(host="a.com", uri="/xyz"),
+        Hint(host="a.com", uri="/xy"),
+        Hint(host="a.com"),
+        Hint(host="a.com", port=443),
+        Hint(host="a.com", port=8080),
+        Hint(host="b.a.com", uri="/x"),
+        Hint(host="z.com"),
+        Hint(uri="/xyq"),
+        Hint(uri="/zzz"),
+        Hint(host="*"),           # exact match on the wildcard key
+        Hint(host="q.*"),         # suffix match on the wildcard key
+        Hint(uri="*"),            # exact uri match on wildcard uri key
+    ]
+    check_hints(rules, hints)
+
+
+def test_hint_fp_no_host_rules_and_empty():
+    rules = [HintRule(port=443), HintRule(uri="/a"), HintRule(host="h.io")]
+    hints = [Hint(port=443), Hint(host="h.io", port=443), Hint(uri="/a/b"),
+             Hint(host="x.h.io", uri="/a")]
+    check_hints(rules, hints)
+
+
+def test_hint_fp_long_host_boundaries():
+    h64 = "a" * 31 + "." + "b" * 32  # len 64
+    rules = [HintRule(host=h64), HintRule(host="b" * 32)]
+    hints = [Hint(host=h64), Hint(host="x." + h64), Hint(host="q" + h64)]
+    check_hints(rules, hints)
+
+
+def test_hint_fp_member_overflow_growth():
+    # one host shared by many (uri, port) variants: hM must grow past
+    # the default and stay exact
+    rules = [HintRule(host="big.io", uri=f"/p{i}") for i in range(9)]
+    rules += [HintRule(host="big.io", port=1000 + i) for i in range(5)]
+    hints = [Hint(host="big.io", uri="/p7/x"), Hint(host="big.io", port=1003),
+             Hint(host="big.io", uri="/nope")]
+    check_hints(rules, hints)
+
+
+def test_cidr_fp_route_parity():
+    rt = RouteTable()
+    for i in range(200):
+        ml = rnd.choice([0, 8, 12, 16, 24, 32])
+        ip = bytes([10 + i % 5, rnd.randint(0, 255), rnd.randint(0, 255), 0])
+        m = mask_bytes(ml)
+        net = Network(bytes(np.frombuffer(ip, np.uint8) &
+                            np.frombuffer(m, np.uint8)), m)
+        try:
+            rt.add(RouteRule(f"r{i}", net))
+        except ValueError:
+            continue
+    nets = [r.rule for r in rt.rules]
+    tab = F.compile_cidr_fp(nets)
+    addrs = [bytes([10 + rnd.randint(0, 6), rnd.randint(0, 255),
+                    rnd.randint(0, 255), rnd.randint(0, 255)])
+             for _ in range(400)]
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam, None))
+    got4 = np.asarray(F.cidr_fp_match(tab.arrays_v4, a16, fam, None))
+    for i, a in enumerate(addrs):
+        want = next((j for j, n in enumerate(nets) if n.contains_ip(a)), -1)
+        assert got[i] == want, (i, a.hex(), int(got[i]), want)
+        assert got4[i] == want, (i, a.hex(), int(got4[i]), want)
+
+
+def test_cidr_fp_acl_port_buckets():
+    net = Network(parse_ip("10.1.0.0"), mask_bytes(16))
+    acl = [
+        AclRule("a", net, Proto.TCP, 80, 80, False),
+        AclRule("b", net, Proto.TCP, 0, 1000, True),
+        AclRule("c", net, Proto.TCP, 0, 65535, False),
+        AclRule("d", Network(parse_ip("0.0.0.0"), mask_bytes(0)),
+                Proto.TCP, 0, 65535, True),
+    ]
+    nets = [r.network for r in acl]
+    tab = F.compile_cidr_fp(nets, acl=acl)
+    addrs = [parse_ip("10.1.2.3")] * 4 + [parse_ip("9.9.9.9")]
+    ports = np.asarray([80, 443, 2000, 65535, 80], np.int32)
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam, ports))
+    for i in range(len(addrs)):
+        want = oracle.acl_first_match(acl, Proto.TCP, addrs[i], int(ports[i]))
+        assert got[i] == want, (i, int(got[i]), want)
+
+
+def test_cidr_fp_acl_pruning_keeps_first_match():
+    # member 0 contains member 1's range -> 1 pruned; 2 disjoint -> kept
+    net = Network(parse_ip("10.2.0.0"), mask_bytes(16))
+    acl = [
+        AclRule("a", net, Proto.TCP, 0, 9000, True),
+        AclRule("b", net, Proto.TCP, 100, 200, False),   # shadowed by a
+        AclRule("c", net, Proto.TCP, 9500, 9600, False),
+    ]
+    tab = F.compile_cidr_fp([r.network for r in acl], acl=acl)
+    a16, fam = T.encode_ips([parse_ip("10.2.3.4")] * 3)
+    ports = np.asarray([150, 9550, 9999], np.int32)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam, ports))
+    assert list(got) == [0, 2, -1]
+
+
+def test_cidr_fp_mixed_families():
+    v4net = Network(parse_ip("192.168.0.0"), mask_bytes(16))
+    v6net = Network(parse_ip("fd00::"), mask_bytes(8))
+    nets = [v4net, v6net]
+    tab = F.compile_cidr_fp(nets)
+    addrs = [parse_ip("192.168.3.4"),
+             parse_ip("::192.168.3.4"),
+             parse_ip("::ffff:192.168.3.4"),
+             parse_ip("fd00::1"),
+             parse_ip("192.169.0.1")]
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam, None))
+    for i, a in enumerate(addrs):
+        want = next((j for j, n in enumerate(nets) if n.contains_ip(a)), -1)
+        assert got[i] == want, (i, int(got[i]), want)
+
+
+def test_fp_vs_hashmatch_cross_check():
+    # byte-verified cuckoo kernel and fp kernel must agree everywhere
+    from vproxy_tpu.ops import hashmatch as H
+    rules = [rand_hint_rule() for _ in range(150)]
+    hints = [rand_hint() for _ in range(300)]
+    ht = H.compile_hint_hash(rules)
+    ft = F.compile_hint_fp(rules)
+    a = np.asarray(H.hint_hash_match(
+        ht.arrays, H.encode_hint_queries(hints, ht))[0])
+    b = np.asarray(F.hint_fp_match(
+        ft.arrays, F.encode_hint_queries_fp(hints, ft))[0])
+    np.testing.assert_array_equal(a, b)

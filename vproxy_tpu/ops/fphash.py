@@ -1,0 +1,762 @@
+"""Fingerprint-verified single-probe hash kernels — the gather-lean path.
+
+Round-3 measurement (PERF_NOTES.md) showed this device's cost model is
+dominated by GATHERED-ROW COUNT: ~7ns per gathered row regardless of
+dtype/table size, with wide rows nearly free, while elementwise math and
+matmuls are orders of magnitude cheaper. The cuckoo kernels in
+ops/hashmatch.py verify probes by gathering key bytes and expand
+candidate buckets into item-index gathers — ~3,400 gathered rows per
+query. These kernels re-express the SAME matching semantics (reference
+Upstream.searchForGroup Upstream.java:187-198, Hint.matchLevel
+Hint.java:92-160, RouteTable.lookup RouteTable.java:44, SecurityGroup
+.allow SecurityGroup.java:30-45) at ~1 gathered row per probe:
+
+* single-probe tables: slot = fnv32(key, salt_slot) & (cap-1); slot
+  collisions live INLINE in the slot record (E entries per row), so
+  there is no second salt probe and no cuckoo displacement;
+* each slot row packs everything the probe needs — per-entry 64-bit
+  fingerprint (two independent salted FNV-32s) plus per-member metadata
+  (rule index, port, uri/host fingerprints) — into ONE wide i32 row;
+* verification is by fingerprint, not byte compare. Build REJECTS any
+  table where two distinct co-slotted keys share a fingerprint pair
+  (re-salts), so lookups are exact for every key IN the table; a query
+  key not in the table can false-positive with probability 2^-64 per
+  probe (and build also forbids the (0,0) pair used to mark empty
+  slots). At 10M queries/s * ~30 probes that is one wrong verdict per
+  ~50k years; callers needing certainty use the byte-verified
+  ops/hashmatch.py path (engine backend "jax").
+* LPM/ACL groups collapse bucket-item expansion into the row itself:
+  route entries carry the precomputed min-rule-index of their bucket
+  (identical masked patterns -> ordered-scan winner is the min index);
+  ACL entries carry (idx, port-range) members inline.
+
+Costs per query (P host probes, L rule-uri lengths, E entries, M
+members, G cidr groups): hint = P + L + (P*E*M + L*E*M + wildcard)
+rows; route = G rows; ACL = G rows. For the benchmark's 100k-rule
+tables that is ~100 rows/query vs ~3,400 — a ~25x cut in the measured
+cost driver.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..rules.ir import AclRule, HintRule
+from . import cuckoo as CK
+from .hashmatch import MAXP_TIERS, CapsExceeded, _pow2
+from .tables import MAX_HOST, MAX_URI, V4, V6, _pad_cap
+
+HOST_SHIFT = 10
+URI_MAX_SCORE = 1023
+DOT = ord(".")
+LSET_MAX = 128  # lset index packs into 7 meta bits
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    """murmur3 finalizer: FNV-1a's final multiply leaves the low bits a
+    pure function of the tail byte's low bits (no avalanche), which
+    collapses `hash & (cap-1)` slot spreading for structured keys —
+    measured E=30 slot pileups on the bench ACL table. Must stay
+    bit-identical to the device version below."""
+    h = np.asarray(h, np.uint32)
+    with np.errstate(over="ignore"):
+        h = h ^ (h >> 16)
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * np.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+    return h
+
+
+def rolling_fnv32(qbytes: np.ndarray, salt: int) -> np.ndarray:
+    """uint8 [B, L] -> uint32 [B, L+1]; column p = fmix32(fnv32 of the
+    row prefix [:p])."""
+    b, l = qbytes.shape
+    out = np.empty((b, l + 1), dtype=np.uint32)
+    h = np.full(b, CK.FNV32_OFFSET ^ np.uint32(salt), dtype=np.uint32)
+    out[:, 0] = h
+    with np.errstate(over="ignore"):
+        for p in range(l):
+            h = (h ^ qbytes[:, p].astype(np.uint32)) * CK.FNV32_PRIME
+            out[:, p + 1] = h
+    return _fmix32_np(out)
+
+
+def fnv32_bytes(key: bytes, salt: int) -> int:
+    h = CK.FNV32_OFFSET ^ np.uint32(salt)
+    with np.errstate(over="ignore"):
+        for by in key:
+            h = np.uint32((h ^ np.uint32(by)) * CK.FNV32_PRIME)
+    return int(_fmix32_np(h))
+
+
+def fnv32_words_np(words: np.ndarray, salt) -> np.ndarray:
+    """uint32 [..., 4] -> uint32 [...]; fmix32(FNV-32) over LE-packed
+    u32 words (4 rounds instead of 16 byte rounds — cheaper on device)."""
+    h = np.full(words.shape[:-1], 0, np.uint32)
+    h[...] = CK.FNV32_OFFSET ^ np.uint32(salt)
+    with np.errstate(over="ignore"):
+        for p in range(4):
+            h = (h ^ words[..., p]) * CK.FNV32_PRIME
+    return _fmix32_np(h)
+
+
+def _fnv32_words_dev(words: jnp.ndarray, salt: jnp.ndarray) -> jnp.ndarray:
+    """words [B, G, 4] u32, salt [G] u32 -> [B, G] u32; bit-identical
+    to fnv32_words_np (incl. the fmix32 finalizer)."""
+    h = jnp.broadcast_to((jnp.uint32(CK.FNV32_OFFSET) ^ salt)[None, :],
+                         words.shape[:-1])
+    prime = jnp.uint32(CK.FNV32_PRIME)
+    for p in range(4):
+        h = (h ^ words[..., p]) * prime
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _pack_words16(b16: np.ndarray) -> np.ndarray:
+    """uint8 [..., 16] -> uint32 [..., 4] little-endian."""
+    w = b16.astype(np.uint32).reshape(b16.shape[:-1] + (4, 4))
+    return w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+
+
+def _pack_words16_dev(b16: jnp.ndarray) -> jnp.ndarray:
+    w = b16.astype(jnp.uint32).reshape(b16.shape[:-1] + (4, 4))
+    return w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+
+
+def _i32(u) -> np.ndarray:
+    """uint32 bits viewed as int32 (device tables are all-i32)."""
+    return np.asarray(u, np.uint32).view(np.int32)
+
+
+class FpBuildError(Exception):
+    pass
+
+
+def _place_fp(keys: Sequence[bytes], hasher, cap: int, salt_base: int,
+              max_attempts: int = 16):
+    """Place keys into cap slots (single probe); returns (salts, slot[],
+    fp1[], fp2[], per-slot entry lists). Re-salts until no two co-slotted
+    distinct keys share a fingerprint pair and no pair is (0, 0)."""
+    for attempt in range(max_attempts):
+        s_slot = 0x9E3779B1 ^ (salt_base * 2654435761 + attempt * 40503) & 0x7FFFFFFF
+        s_fp1 = (s_slot * 3 + 0x85EBCA6B) & 0x7FFFFFFF
+        s_fp2 = (s_slot * 7 + 0xC2B2AE35) & 0x7FFFFFFF
+        slots = {}
+        ok = True
+        for k in keys:
+            sl = hasher(k, s_slot) & (cap - 1)
+            f1, f2 = hasher(k, s_fp1), hasher(k, s_fp2)
+            if f1 == 0 and f2 == 0:
+                ok = False
+                break
+            ent = slots.setdefault(sl, [])
+            if any(ef1 == f1 and ef2 == f2 for _, ef1, ef2 in ent):
+                ok = False
+                break
+            ent.append((k, f1, f2))
+        if ok:
+            return (s_slot, s_fp1, s_fp2), slots
+    raise FpBuildError(f"fingerprint salting failed after {max_attempts}")
+
+
+# --------------------------------------------------------------- hint side
+
+
+@dataclass
+class FpHintTable:
+    """Compiled packed hint table. `caps` carries every static dimension
+    for shape-stable rebuilds (sharding / runtime updates)."""
+
+    n: int
+    r_cap: int
+    arrays: dict
+    host_cap: int
+    host_salts: tuple  # (slot, fp1, fp2) — fp salts shared with q_hmeta
+    uri_cap: int
+    uri_salts: tuple   # (slot, fp1, fp2) — fp salts shared with up_fp
+    lset: list
+    hw: int
+    uw: int
+    caps: dict = field(default_factory=dict)
+
+
+def _prune_list(rules, items, sig):
+    seen, keep = set(), []
+    for i in sorted(items):
+        s = sig(rules[i])
+        if s not in seen:
+            seen.add(s)
+            keep.append(i)
+    return keep
+
+
+def _host_member(r: HintRule, idx: int, lset_pos: dict,
+                 usalts: tuple) -> list:
+    """Member record for host-bucket / wh entries: the rule's URI side.
+    meta = port | uri_kind<<16 | lset_idx<<18. A "*" uri keeps its
+    content fingerprint too: a literal query uri "*" (or "*x...")
+    content-matches at score len+1, above the wildcard level 1."""
+    if r.uri is None:
+        kind, lidx, f1, f2 = 0, 0, 0, 0
+    else:
+        ub = r.uri.encode()
+        kind = 2 if r.uri == "*" else 1
+        lidx = lset_pos[len(ub)]
+        f1, f2 = fnv32_bytes(ub, usalts[1]), fnv32_bytes(ub, usalts[2])
+    meta = (r.port & 0xFFFF) | (kind << 16) | (lidx << 18)
+    return [meta, idx, int(_i32(f1)), int(_i32(f2))]
+
+
+def _uri_member(r: HintRule, idx: int, hsalts: tuple) -> list:
+    """Member record for uri-bucket / wu entries: the rule's HOST side.
+    meta = port | host_kind<<16 | host_len<<18. Host fingerprints are
+    over the REVERSED host bytes so they equal the query's rolling
+    fingerprint at position host_len; a "*" host keeps its content
+    fingerprint (literal "*" / ".*"-suffix queries score 3/2)."""
+    if r.host is None:
+        kind, hlen, f1, f2 = 0, 0, 0, 0
+    else:
+        hb = r.host.encode()[::-1]
+        kind = 2 if r.host == "*" else 1
+        hlen = len(hb)
+        f1, f2 = fnv32_bytes(hb, hsalts[1]), fnv32_bytes(hb, hsalts[2])
+    meta = (r.port & 0xFFFF) | (kind << 16) | (hlen << 18)
+    return [meta, idx, int(_i32(f1)), int(_i32(f2))]
+
+
+def _fill_rec(cap: int, e: int, m: int, slots: dict, buckets: dict,
+              member_of) -> np.ndarray:
+    """rec [cap, e*(2+4m)] i32: per entry [fp1, fp2, m*(meta,idx,f1,f2)];
+    empty entries keep fp (0,0); unused member slots keep idx -1."""
+    ew = 2 + 4 * m
+    rec = np.zeros((cap, e * ew), np.int32)
+    for j in range(e):
+        rec[:, j * ew + 3::4][:, :m] = -1  # idx lanes
+    for sl, ents in slots.items():
+        for j, (key, f1, f2) in enumerate(ents):
+            base = j * ew
+            rec[sl, base] = _i32(f1)
+            rec[sl, base + 1] = _i32(f2)
+            for mi, ridx in enumerate(buckets[key]):
+                rec[sl, base + 2 + 4 * mi: base + 6 + 4 * mi] = \
+                    member_of(ridx)
+    return rec
+
+
+def compile_hint_fp(rules: Sequence[HintRule],
+                    caps: Optional[dict] = None) -> FpHintTable:
+    caps = dict(caps or {})
+    n = len(rules)
+    r_cap = caps.get("r_cap") or _pad_cap(n, 256)
+    if n > r_cap:
+        r_cap = _pad_cap(n, 256)
+    assert 4095 * (r_cap + 1) + r_cap < 2**31, "table too large for i32 packing"
+
+    host_buckets: dict[bytes, list[int]] = {}
+    uri_buckets: dict[bytes, list[int]] = {}
+    wh: list[int] = []
+    wu: list[int] = []
+    max_hl = max_ul = 0
+    for i, r in enumerate(rules):
+        if r.is_empty():
+            continue
+        if r.host is not None:
+            hb = r.host.encode()
+            if len(hb) > MAX_HOST:
+                raise ValueError(f"host rule longer than {MAX_HOST}: {r.host!r}")
+            max_hl = max(max_hl, len(hb))
+            host_buckets.setdefault(hb[::-1], []).append(i)
+            if r.host == "*":
+                wh.append(i)
+        if r.uri is not None:
+            ub = r.uri.encode()
+            if len(ub) > MAX_URI:
+                raise ValueError(f"uri rule longer than {MAX_URI}: {r.uri!r}")
+            max_ul = max(max_ul, len(ub))
+            uri_buckets.setdefault(ub, []).append(i)
+            if r.uri == "*":
+                wu.append(i)
+
+    hw = min(MAX_HOST + 1, max(caps.get("hw", 0), _pow2(max_hl + 1, 8)))
+    uw = min(MAX_URI, max(caps.get("uw", 0), _pow2(max(max_ul, 1), 8)))
+
+    # pruning: identical exactness arguments as ops/hashmatch.py:166-181
+    for k in host_buckets:
+        host_buckets[k] = _prune_list(rules, host_buckets[k],
+                                      lambda r: (r.uri, r.port))
+    for k in uri_buckets:
+        uri_buckets[k] = _prune_list(rules, uri_buckets[k], lambda r: r.port)
+    wh = _prune_list(rules, wh, lambda r: (r.uri, r.port))
+    wu = _prune_list(rules, wu, lambda r: r.port)
+
+    # lset covers "*" too: wildcard-uri CONTENT matches ride the probes
+    lset = sorted({len(r.uri.encode()) for r in rules
+                   if r.uri is not None and not r.is_empty()})
+    if len(lset) > LSET_MAX:
+        raise FpBuildError(f"more than {LSET_MAX} distinct uri lengths")
+    lset_cap = max(caps.get("lset", 0), _pow2(max(len(lset), 1), 4))
+    if len(lset) > lset_cap:
+        lset_cap = _pow2(len(lset), 4)
+    lset_pos = {l: j for j, l in enumerate(lset)}
+
+    def table_for(buckets, salt_base, cap_key, e_key, m_key):
+        cap = max(caps.get(cap_key, 0), _pow2(2 * max(len(buckets), 1), 16))
+        if len(buckets) > cap:  # keep load factor <= 0.5 when reused
+            cap = _pow2(2 * len(buckets), 16)
+        salts, slots = _place_fp(list(buckets.keys()), fnv32_bytes, cap,
+                                 salt_base)
+        e_need = max((len(v) for v in slots.values()), default=1)
+        m_need = max((len(v) for v in buckets.values()), default=1)
+        e = max(caps.get(e_key, 0), e_need)
+        m = max(caps.get(m_key, 0), m_need)
+        return cap, salts, slots, e, m
+
+    host_cap, hsalts, hslots, hE, hM = table_for(
+        host_buckets, 11, "host_cap", "hE", "hM")
+    uri_cap, usalts, uslots, uE, uM = table_for(
+        uri_buckets, 23, "uri_cap", "uE", "uM")
+
+    host_rec = _fill_rec(host_cap, hE, hM, hslots, host_buckets,
+                         lambda i: _host_member(rules[i], i, lset_pos, usalts))
+    uri_rec = _fill_rec(uri_cap, uE, uM, uslots, uri_buckets,
+                        lambda i: _uri_member(rules[i], i, hsalts))
+
+    whc = max(caps.get("whc", 0), _pow2(max(len(wh), 1), 2))
+    wuc = max(caps.get("wuc", 0), _pow2(max(len(wu), 1), 2))
+    wh_rec = np.zeros((whc, 4), np.int32)
+    wh_rec[:, 1] = -1
+    for j, i in enumerate(wh):
+        wh_rec[j] = _host_member(rules[i], i, lset_pos, usalts)
+    wu_rec = np.zeros((wuc, 4), np.int32)
+    wu_rec[:, 1] = -1
+    for j, i in enumerate(wu):
+        wu_rec[j] = _uri_member(rules[i], i, hsalts)
+
+    lset_arr = np.full(lset_cap, -1, np.int32)
+    lset_arr[: len(lset)] = lset
+
+    arrays = {
+        "host_rec": host_rec, "uri_rec": uri_rec,
+        "wh_rec": wh_rec, "wu_rec": wu_rec,
+        "lset": lset_arr,
+        "rcap_iota": np.zeros(r_cap, np.int32),
+        "h_em": np.zeros((hE, hM), np.int32),   # shape carriers
+        "u_em": np.zeros((uE, uM), np.int32),
+    }
+    new_caps = {"r_cap": r_cap, "host_cap": host_cap, "uri_cap": uri_cap,
+                "hE": hE, "hM": hM, "uE": uE, "uM": uM,
+                "whc": whc, "wuc": wuc, "lset": lset_cap,
+                "hw": hw, "uw": uw}
+    if caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
+                    for k in new_caps):
+        raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
+    return FpHintTable(
+        n=n, r_cap=r_cap, arrays=arrays,
+        host_cap=host_cap, host_salts=hsalts,
+        uri_cap=uri_cap, uri_salts=usalts,
+        lset=lset, hw=hw, uw=uw, caps=new_caps)
+
+
+def encode_hint_queries_fp(hints: Sequence, tab: FpHintTable) -> dict:
+    """Hints -> device-ready probe arrays. All hashing is host-side
+    numpy rolling FNV-32 (three salts per table: slot + fingerprint
+    pair); the kernel never touches query BYTES, only fingerprints."""
+    b = len(hints)
+    W = tab.hw
+    q_hostb = np.zeros((b, W), np.uint8)
+    q_hlen = np.zeros(b, np.int32)
+    q_has_host = np.zeros(b, bool)
+    q_urib = np.zeros((b, tab.uw), np.uint8)
+    q_ulen = np.zeros(b, np.int32)
+    q_has_uri = np.zeros(b, bool)
+    q_port = np.zeros(b, np.int32)
+    for i, h in enumerate(hints):
+        if h.host is not None:
+            hb = h.host.encode()[::-1]
+            q_hlen[i] = min(len(hb), 1 << 20)
+            q_hostb[i, : min(len(hb), W)] = np.frombuffer(hb[:W], np.uint8)
+            q_has_host[i] = True
+        if h.uri is not None:
+            ub = h.uri.encode()
+            q_ulen[i] = min(len(ub), 1 << 20)
+            q_urib[i, : min(len(ub), tab.uw)] = np.frombuffer(
+                ub[: tab.uw], np.uint8)
+            q_has_uri[i] = True
+        q_port[i] = h.port
+
+    hs = [rolling_fnv32(q_hostb[:, : W - 1], s) for s in tab.host_salts]
+    pos = np.arange(W)[None, :]
+    # probes: every dot position (suffix rules) + the exact-length slot
+    probe_ok = np.concatenate([
+        (q_hostb == DOT) & (pos < q_hlen[:, None]) & (pos >= 1),
+        (q_has_host & (q_hlen <= W - 1))[:, None],
+    ], axis=1) & q_has_host[:, None]  # [B, W+1]
+    probe_len = np.concatenate([
+        np.broadcast_to(pos, (b, W)), q_hlen[:, None]], axis=1)
+    probe_lvl = np.concatenate([
+        np.full((b, W), 2, np.int32), np.full((b, 1), 3, np.int32)], axis=1)
+    need = int(probe_ok.sum(axis=1).max(initial=0))
+    maxp = next((t for t in MAXP_TIERS if t >= need), MAXP_TIERS[-1])
+    order = np.argsort(~probe_ok, axis=1, kind="stable")[:, :maxp]
+    pv = np.take_along_axis(probe_ok, order, 1)
+    pl = np.where(pv, np.take_along_axis(probe_len, order, 1), 0)
+    mask = np.uint32(tab.host_cap - 1)
+    hp_slot = np.where(pv, np.take_along_axis(hs[0], pl, 1) & mask, 0)
+    hp_fp1 = np.where(pv, np.take_along_axis(hs[1], pl, 1), 0)
+    hp_fp2 = np.where(pv, np.take_along_axis(hs[2], pl, 1), 0)
+    hp_level = np.where(pv, np.take_along_axis(probe_lvl, order, 1), 0)
+
+    # q_hmeta[p] = (fp1, fp2, isdot) of the reversed-host prefix [:p] —
+    # what a uri-bucket member's host fingerprint is compared against.
+    # Positions beyond the query host length are zeroed so a longer rule
+    # host can never fp-match the rolling hash of padding.
+    valid_p = np.arange(W)[None, :] <= np.minimum(q_hlen, W - 1)[:, None]
+    isdot = np.concatenate([
+        (q_hostb == DOT) & (pos >= 1) & (pos < q_hlen[:, None]),
+    ], axis=1)
+    q_hmeta = np.zeros((b, W, 3), np.int32)
+    q_hmeta[:, :, 0] = np.where(valid_p, hs[1][:, :W], 0).view(np.int32)
+    q_hmeta[:, :, 1] = np.where(valid_p, hs[2][:, :W], 0).view(np.int32)
+    q_hmeta[:, :, 2] = isdot
+
+    us = [rolling_fnv32(q_urib, s) for s in tab.uri_salts]
+    lset_cap = tab.caps["lset"]
+    lset = np.full(lset_cap, -1, np.int32)
+    lset[: len(tab.lset)] = tab.lset
+    lv = (lset[None, :] >= 0) & (lset[None, :] <= q_ulen[:, None]) & \
+        q_has_uri[:, None]
+    ll = np.where(lv, np.maximum(lset[None, :], 0), 0)
+    umask = np.uint32(tab.uri_cap - 1)
+    up_slot = np.where(lv, np.take_along_axis(us[0], ll, 1) & umask, 0)
+    up_fp1 = np.where(lv, np.take_along_axis(us[1], ll, 1), 0)
+    up_fp2 = np.where(lv, np.take_along_axis(us[2], ll, 1), 0)
+    up_score = np.where(lv, np.minimum(ll + 1, URI_MAX_SCORE), 0)
+
+    return {
+        "hp_slot": hp_slot.astype(np.int32),
+        "hp_fp1": hp_fp1.astype(np.uint32).view(np.int32),
+        "hp_fp2": hp_fp2.astype(np.uint32).view(np.int32),
+        "hp_level": hp_level.astype(np.int32),
+        "up_slot": up_slot.astype(np.int32),
+        "up_fp1": up_fp1.astype(np.uint32).view(np.int32),
+        "up_fp2": up_fp2.astype(np.uint32).view(np.int32),
+        "up_score": up_score.astype(np.int32),
+        "q_hmeta": q_hmeta,
+        "hlen": q_hlen, "port": q_port,
+        "has_host": q_has_host, "has_uri": q_has_uri,
+    }
+
+
+def _member_fields(members: jnp.ndarray):
+    """members [..., 4] -> (port, kind, aux, idx, f1, f2)."""
+    meta = members[..., 0]
+    return (meta & 0xFFFF, (meta >> 16) & 3, (meta >> 18) & 0x7F,
+            members[..., 1], members[..., 2], members[..., 3])
+
+
+def hint_fp_match(t: dict, q: dict):
+    """-> (best rule idx [B] i32 or -1, best level [B] i32). One wide
+    row gather per probe + one 3-lane take per candidate."""
+    r_cap = t["rcap_iota"].shape[0]
+    b = q["hp_slot"].shape[0]
+    hE, hM = t["h_em"].shape
+    uE, uM = t["u_em"].shape
+    port = q["port"][:, None]
+    has_uri = q["has_uri"][:, None]
+    has_host = q["has_host"][:, None]
+
+    # per-candidate URI evaluation data, packed once: [B, Lc, 3]
+    q_umeta = jnp.stack([q["up_fp1"], q["up_fp2"], q["up_score"]], axis=-1)
+
+    def uri_side_level(lidx, uf1, uf2, ukind, shape):
+        """uri_level for host-side members (kind: 0 none / 1 normal /
+        2 wildcard); lidx indexes this table's lset probes."""
+        um = jnp.take_along_axis(q_umeta, lidx.reshape(b, -1, 1), axis=1)
+        um = um.reshape(shape + (3,))
+        fp_ok = (um[..., 0] == uf1) & (um[..., 1] == uf2) & (um[..., 2] > 0)
+        content = jnp.where(fp_ok, um[..., 2], 0)
+        wild = has_uri.reshape(
+            (b,) + (1,) * (len(shape) - 1)).astype(jnp.int32)
+        return jnp.where(ukind == 1, content,
+                         jnp.where(ukind == 2,
+                                   jnp.maximum(content, wild), 0))
+
+    def host_side_level(hlen, hf1, hf2, hkind, shape):
+        """host_level for uri-side members: exact 3 / dot-suffix 2 /
+        wildcard 1, via the rolling q_hmeta fingerprints."""
+        hm = jnp.take_along_axis(q["q_hmeta"],
+                                 jnp.clip(hlen, 0, q["q_hmeta"].shape[1] - 1)
+                                 .reshape(b, -1, 1), axis=1)
+        hm = hm.reshape(shape + (3,))
+        fp_ok = (hm[..., 0] == hf1) & (hm[..., 1] == hf2)
+        qhlen = q["hlen"].reshape((b,) + (1,) * (len(shape) - 1))
+        exact = fp_ok & (hlen == qhlen)
+        suffix = fp_ok & (hm[..., 2] != 0)
+        hh = has_host.reshape((b,) + (1,) * (len(shape) - 1))
+        lvl = jnp.maximum(jnp.where(exact, 3, 0), jnp.where(suffix, 2, 0))
+        return jnp.where(hkind == 1, lvl,
+                         jnp.where(hkind == 2,
+                                   jnp.maximum(lvl, hh.astype(jnp.int32)), 0))
+
+    cands = []
+
+    def add(level, idx, mport):
+        pg = (port.reshape((b,) + (1,) * (level.ndim - 1)) == 0) | \
+            (mport == 0) | (mport == port.reshape(
+                (b,) + (1,) * (level.ndim - 1)))
+        lv = jnp.where((idx >= 0) & pg, level, 0)
+        cands.append((lv.reshape(b, -1), idx.reshape(b, -1)))
+
+    # ---- host-table probes: [B, P] rows -> entries -> members
+    hrows = t["host_rec"][q["hp_slot"]].reshape(b, -1, hE, 2 + 4 * hM)
+    h_ok = (hrows[..., 0] == q["hp_fp1"][:, :, None]) & \
+        (hrows[..., 1] == q["hp_fp2"][:, :, None]) & \
+        (q["hp_level"][:, :, None] > 0)
+    hmem = hrows[..., 2:].reshape(b, -1, hE, hM, 4)
+    mport, ukind, lidx, midx, uf1, uf2 = _member_fields(hmem)
+    ul = uri_side_level(lidx, uf1, uf2, ukind, hmem.shape[:-1])
+    hl = q["hp_level"][:, :, None, None]
+    add(jnp.where(h_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
+        jnp.where(h_ok[..., None], midx, -1), mport)
+
+    # ---- uri-table probes: [B, Lc] rows
+    urows = t["uri_rec"][q["up_slot"]].reshape(b, -1, uE, 2 + 4 * uM)
+    u_ok = (urows[..., 0] == q["up_fp1"][:, :, None]) & \
+        (urows[..., 1] == q["up_fp2"][:, :, None]) & \
+        (q["up_score"][:, :, None] > 0)
+    umem = urows[..., 2:].reshape(b, -1, uE, uM, 4)
+    mport, hkind, hlen, midx, hf1, hf2 = _member_fields(umem)
+    hl = host_side_level(hlen, hf1, hf2, hkind, umem.shape[:-1])
+    ul = q["up_score"][:, :, None, None]
+    add(jnp.where(u_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
+        jnp.where(u_ok[..., None], midx, -1), mport)
+
+    # ---- wildcard lists (broadcast, no gather)
+    whm = jnp.broadcast_to(t["wh_rec"][None], (b,) + t["wh_rec"].shape)
+    mport, ukind, lidx, midx, uf1, uf2 = _member_fields(whm)
+    ul = uri_side_level(lidx, uf1, uf2, ukind, whm.shape[:-1])  # [B, whc]
+    hl = has_host.astype(jnp.int32)  # [B, 1]: host="*" level is 1
+    add((hl << HOST_SHIFT) + ul, midx, mport)
+
+    wum = jnp.broadcast_to(t["wu_rec"][None], (b,) + t["wu_rec"].shape)
+    mport, hkind, hlen, midx, hf1, hf2 = _member_fields(wum)
+    hl = host_side_level(hlen, hf1, hf2, hkind, wum.shape[:-1])
+    ul = has_uri.astype(jnp.int32)
+    add((hl << HOST_SHIFT) + ul, midx, mport)
+
+    level = jnp.concatenate([c[0] for c in cands], axis=1)
+    idx = jnp.concatenate([c[1] for c in cands], axis=1)
+    c = jnp.maximum(idx, 0)
+    pack = jnp.where(level > 0, level * (r_cap + 1) + (r_cap - c), 0)
+    best = jnp.max(pack, axis=1)
+    best_level = best // (r_cap + 1)
+    best_idx = r_cap - best % (r_cap + 1)
+    return jnp.where(best > 0, best_idx, -1).astype(jnp.int32), \
+        best_level.astype(jnp.int32)
+
+
+# --------------------------------------------------------------- cidr side
+
+
+def _expand_patterns(net) -> list:
+    """Network -> [(key16, mask16, family)] — same expansion as
+    ops/hashmatch._expand_patterns (Network.maskMatch, Network.java:183)."""
+    from .hashmatch import _expand_patterns as _ep
+    return _ep(net)
+
+
+@dataclass
+class FpCidrTable:
+    """Packed-single-probe CIDR table. Groups (one per (family, mask)
+    pattern) are laid out family-V4-first so an all-V4 batch can run on
+    the `arrays_v4` slice (about 1/3 of the groups — the v4-in-v6
+    duplicate patterns only serve V6-typed queries)."""
+
+    n: int
+    r_cap: int
+    arrays: dict
+    n4: int  # padded count of leading V4-family groups
+    caps: dict = field(default_factory=dict)
+
+    @property
+    def arrays_v4(self) -> dict:
+        g_keys = ("g_mask4", "g_fam", "g_salt_s", "g_salt_f1", "g_salt_f2",
+                  "g_off", "g_capmask")
+        return {k: (v[: self.n4] if k in g_keys else v)
+                for k, v in self.arrays.items()}
+
+
+def _fnv32_key16(key: bytes, salt: int) -> int:
+    return int(fnv32_words_np(_pack_words16(
+        np.frombuffer(key, np.uint8)), salt))
+
+
+def _prune_acl_members(items: list, acl) -> list:
+    """Members share one network; drop j when an earlier member's port
+    range contains j's (the earlier one is always the first match)."""
+    keep = []
+    for j in sorted(items):
+        if not any(acl[i].min_port <= acl[j].min_port and
+                   acl[i].max_port >= acl[j].max_port for i in keep):
+            keep.append(j)
+    return keep
+
+
+def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
+                    caps: Optional[dict] = None) -> FpCidrTable:
+    caps = dict(caps or {})
+    n = len(networks)
+    r_cap = caps.get("r_cap") or _pad_cap(n, 256)
+    if n > r_cap:
+        r_cap = _pad_cap(n, 256)
+
+    groups: dict[tuple, dict[bytes, list[int]]] = {}
+    for i, net in enumerate(networks):
+        for key, mask, fam in _expand_patterns(net):
+            groups.setdefault((fam, mask), {}).setdefault(key, []).append(i)
+
+    g4 = sorted(k for k in groups if k[0] == V4)
+    g6 = sorted(k for k in groups if k[0] != V4)
+    n4 = max(caps.get("n4", 0), _pow2(max(len(g4), 1), 4))
+    if len(g4) > n4:
+        n4 = _pow2(len(g4), 4)
+    n6 = max(caps.get("n6", 0), _pow2(max(len(g6), 1), 4))
+    if len(g6) > n6:
+        n6 = _pow2(len(g6), 4)
+    g_cap = n4 + n6
+
+    if acl is not None:
+        for buckets in groups.values():
+            for k in buckets:
+                buckets[k] = _prune_acl_members(buckets[k], acl)
+    # route entries collapse to (fp, min idx); ACL rules sharing one
+    # network become one 4-lane entry EACH (same fp, own port range) —
+    # the entry axis absorbs members, keeping rows narrow under the
+    # TPU's pad-last-dim-to-128 tiling
+    ew = 3 if acl is None else 4
+
+    g_mask4 = np.zeros((g_cap, 4), np.uint32)
+    g_fam = np.full(g_cap, -1, np.int32)
+    g_salt = np.zeros((3, g_cap), np.uint32)
+    g_off = np.zeros(g_cap, np.int32)
+    g_capmask = np.zeros(g_cap, np.int32)
+
+    placed = []  # (gi, cap, salts, slots, buckets)
+    off = 0
+    e_need = 1
+    # v4 groups occupy [0, len(g4)), v6 groups [n4, n4+len(g6))
+    order = [(i, k) for i, k in enumerate(g4)] + \
+            [(n4 + i, k) for i, k in enumerate(g6)]
+    for gi, (fam, mask) in order:
+        buckets = groups[(fam, mask)]
+        cap = _pow2(2 * max(len(buckets), 1), 4)
+        salts, slots = _place_fp(list(buckets.keys()), _fnv32_key16, cap,
+                                 salt_base=101 + gi)
+        e_need = max(e_need, max(
+            (sum(1 if acl is None else len(buckets[k])
+                 for k, _, _ in v) for v in slots.values()), default=1))
+        g_mask4[gi] = _pack_words16(np.frombuffer(mask, np.uint8))
+        g_fam[gi] = fam
+        g_salt[0][gi], g_salt[1][gi], g_salt[2][gi] = salts
+        g_off[gi] = off
+        g_capmask[gi] = cap - 1
+        placed.append((gi, cap, salts, slots, buckets))
+        off += cap
+
+    E = max(caps.get("E", 0), e_need)
+    if E > 128:
+        raise FpBuildError(f"degenerate slot pileup: E={E}")
+    ct = max(caps.get("ct", 0), _pow2(max(off, 1), 256))
+    rec = np.zeros((ct, E * ew), np.int32)
+    for gi, cap, salts, slots, buckets in placed:
+        base_off = g_off[gi]
+        for sl, ents in slots.items():
+            row = base_off + sl
+            j = 0
+            for key, f1, f2 in ents:
+                if acl is None:
+                    rec[row, j * ew: j * ew + 3] = [
+                        _i32(f1), _i32(f2), min(buckets[key])]
+                    j += 1
+                    continue
+                for ridx in buckets[key]:
+                    r = acl[ridx]
+                    rec[row, j * ew: j * ew + 4] = [
+                        _i32(f1), _i32(f2), ridx,
+                        _i32((r.min_port & 0xFFFF) |
+                             ((r.max_port & 0xFFFF) << 16))]
+                    j += 1
+
+    allow = np.zeros(r_cap, bool)
+    if acl is not None:
+        for i, r in enumerate(acl):
+            allow[i] = r.allow
+
+    arrays = {
+        "g_mask4": g_mask4, "g_fam": g_fam,
+        "g_salt_s": g_salt[0], "g_salt_f1": g_salt[1], "g_salt_f2": g_salt[2],
+        "g_off": g_off, "g_capmask": g_capmask,
+        "rec": rec, "allow": allow,
+        "rcap_iota": np.zeros(r_cap, np.int32),
+        "e_m": np.zeros((E, 1), np.int32),
+    }
+    new_caps = {"r_cap": r_cap, "n4": n4, "n6": n6, "E": E, "ct": ct}
+    if caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
+                    for k in new_caps):
+        raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
+    return FpCidrTable(n=n, r_cap=r_cap, arrays=arrays, n4=n4,
+                       caps=new_caps)
+
+
+def cidr_fp_match(t: dict, addr16: jnp.ndarray, fam: jnp.ndarray,
+                  port: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """-> first-matching rule index [B] i32 (ordered-scan semantics), -1
+    if none. One wide row gather per (query, group)."""
+    import jax.lax as lax
+
+    r_cap = t["rcap_iota"].shape[0]
+    b = addr16.shape[0]
+    E = t["e_m"].shape[0]
+    ew = t["rec"].shape[1] // E
+
+    aw = _pack_words16_dev(addr16)  # [B, 4] u32
+    masked = aw[:, None, :] & t["g_mask4"][None]  # [B, G, 4]
+    hs = _fnv32_words_dev(masked, t["g_salt_s"])
+    f1 = lax.bitcast_convert_type(
+        _fnv32_words_dev(masked, t["g_salt_f1"]), jnp.int32)
+    f2 = lax.bitcast_convert_type(
+        _fnv32_words_dev(masked, t["g_salt_f2"]), jnp.int32)
+    slot = t["g_off"][None] + (hs & t["g_capmask"].astype(jnp.uint32)[None]
+                               ).astype(jnp.int32)
+    rows = t["rec"][slot]  # [B, G, E*ew] — THE gather
+    gok = (t["g_fam"][None] >= 0) & (fam[:, None] == t["g_fam"][None])
+    ents = rows.reshape(b, -1, E, ew)
+    eok = (ents[..., 0] == f1[:, :, None]) & (ents[..., 1] == f2[:, :, None]) \
+        & gok[:, :, None]
+    if ew == 3:  # route mode: entry carries its bucket's min rule index
+        idx = jnp.where(eok, ents[..., 2], r_cap)
+        first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
+        return jnp.where(first < r_cap, first, -1)
+    # ACL mode: one rule per 4-lane entry (fp, fp, idx, lo|hi<<16)
+    valid = eok
+    if port is not None:
+        ports = ents[..., 3]
+        lo = ports & 0xFFFF
+        hi = (ports >> 16) & 0xFFFF
+        p = port[:, None, None]
+        valid = valid & (lo <= p) & (p <= hi)
+    idx = jnp.where(valid, ents[..., 2], r_cap)
+    first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
+    return jnp.where(first < r_cap, first, -1)
+
+
+hint_fp_jit = jax.jit(hint_fp_match)
+cidr_fp_jit = jax.jit(cidr_fp_match)
