@@ -222,3 +222,33 @@ def test_fp_vs_hashmatch_cross_check():
     b = np.asarray(F.hint_fp_match(
         ft.arrays, F.encode_hint_queries_fp(hints, ft))[0])
     np.testing.assert_array_equal(a, b)
+
+
+def test_engine_fp_backend_update_and_growth():
+    from vproxy_tpu.rules.engine import HintMatcher
+    m = HintMatcher([HintRule(host="a.com")], backend="jax-fp")
+    assert m.match([Hint(host="a.com")])[0] == 0
+    caps0 = dict(m._caps)
+    m.set_rules([HintRule(host="b.com"), HintRule(host="a.com")])
+    assert m.match([Hint(host="a.com")])[0] == 1
+    assert m._caps["r_cap"] == caps0["r_cap"]
+    # growth past capacity rebuilds (CapsExceeded path), stays correct
+    rules = [HintRule(host=f"h{i}.x.io") for i in range(600)]
+    m.set_rules(rules)
+    got = m.match([Hint(host="h123.x.io"), Hint(host="sub.h7.x.io")])
+    assert got[0] == 123 and got[1] == 7
+
+
+def test_engine_fp_vs_host_cross_check():
+    from vproxy_tpu.rules.engine import CidrMatcher, HintMatcher
+    rules = [rand_hint_rule() for _ in range(64)]
+    hints = [rand_hint() for _ in range(128)]
+    got = {be: HintMatcher(rules, backend=be).match(hints)
+           for be in ("jax-fp", "host")}
+    np.testing.assert_array_equal(got["jax-fp"], got["host"])
+
+    nets = [Network(parse_ip("10.0.0.0"), mask_bytes(8)),
+            Network(parse_ip("10.1.0.0"), mask_bytes(16))]
+    m = CidrMatcher(nets, backend="jax-fp")
+    assert m.match([parse_ip("10.1.2.3")])[0] == 0
+    assert m.match([parse_ip("11.0.0.1")])[0] == -1

@@ -47,7 +47,7 @@ import numpy as np
 
 from ..rules.ir import AclRule, HintRule
 from . import cuckoo as CK
-from .hashmatch import MAXP_TIERS, CapsExceeded, _pow2
+from .hashmatch import MAXP_TIERS, CapsExceeded, _pow2, _prune_list
 from .tables import MAX_HOST, MAX_URI, V4, V6, _pad_cap
 
 HOST_SHIFT = 10
@@ -187,15 +187,6 @@ class FpHintTable:
     uw: int
     caps: dict = field(default_factory=dict)
 
-
-def _prune_list(rules, items, sig):
-    seen, keep = set(), []
-    for i in sorted(items):
-        s = sig(rules[i])
-        if s not in seen:
-            seen.add(s)
-            keep.append(i)
-    return keep
 
 
 def _host_member(r: HintRule, idx: int, lset_pos: dict,

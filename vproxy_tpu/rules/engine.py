@@ -9,8 +9,14 @@ the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
 * "host"      — the pure-Python oracle (correctness fallback + latency
                 floor for tiny tables).
 * "jax"       — DEFAULT: cuckoo-hash classify kernels (ops/hashmatch):
-                O(1) probes per query, gather-bound. The 10M matches/s
-                path.
+                O(1) probes per query, byte-verified (exact regardless
+                of hash behavior), gather-bound.
+* "jax-fp"    — packed fingerprint kernels (ops/fphash): ~25x fewer
+                gathered rows per query than "jax" (the measured cost
+                driver, PERF_NOTES.md). Exact for every key in the
+                table; a query key NOT in the table can false-positive
+                with probability 2^-64 per probe. The throughput path —
+                bench.py's 100k-rule TPU numbers ride this backend.
 * "jax-dense" — the dense matmul kernels (ops/matchers): O(rules) MXU
                 work per query; kept as the brute-force cross-check and
                 for rule-axis mesh sharding experiments.
@@ -128,6 +134,16 @@ class HintMatcher:
             self._tab = H.compile_hint_hash(self._rules, caps=self._caps)
             self._caps = self._tab.caps
             self._dev = _to_device(self._tab.arrays)
+        elif self.backend == "jax-fp":
+            from ..ops import fphash as F
+            try:
+                self._tab = F.compile_hint_fp(self._rules, caps=self._caps)
+            except H.CapsExceeded:
+                # update outgrew the reused shapes: fresh build (the
+                # jitted matcher retraces on the new shapes)
+                self._tab = F.compile_hint_fp(self._rules)
+            self._caps = self._tab.caps
+            self._dev = _to_device(self._tab.arrays)
         elif self.backend == "jax-sharded":
             from ..parallel import mesh as M
             if self._mesh is None:
@@ -203,6 +219,11 @@ class HintMatcher:
             q = H.encode_hint_queries(hints, tab)
             idx, _ = H.hint_hash_jit(dev, q)
             return idx
+        if self.backend == "jax-fp":
+            from ..ops import fphash as F
+            q = F.encode_hint_queries_fp(hints, tab)
+            idx, _ = F.hint_fp_jit(dev, q)
+            return idx
         if self.backend == "jax-sharded":
             from ..parallel import mesh as M
             n = len(hints)
@@ -253,6 +274,15 @@ class CidrMatcher:
     def _recompile(self) -> None:
         if self.backend == "jax":
             tab = H.compile_cidr_hash(self._nets, acl=self._acl, caps=self._caps)
+            self._caps = tab.caps
+            self._dev = _to_device(tab.arrays)
+        elif self.backend == "jax-fp":
+            from ..ops import fphash as F
+            try:
+                tab = F.compile_cidr_fp(self._nets, acl=self._acl,
+                                        caps=self._caps)
+            except H.CapsExceeded:
+                tab = F.compile_cidr_fp(self._nets, acl=self._acl)
             self._caps = tab.caps
             self._dev = _to_device(tab.arrays)
         elif self.backend == "jax-sharded":
@@ -338,6 +368,9 @@ class CidrMatcher:
             else np.asarray(ports, np.int32)
         if self.backend == "jax":
             return H.cidr_hash_jit(dev, a16, fam, p)
+        if self.backend == "jax-fp":
+            from ..ops import fphash as F
+            return F.cidr_fp_jit(dev, a16, fam, p)
         if self.backend == "jax-sharded":
             return self._dispatch_sharded(snap, a16, fam, p)
         return cidr_match_jit(dev, a16, fam, p)
