@@ -710,6 +710,45 @@ def _run_stage(name, env_over, timeout, phase_file, cpu=False):
     return None
 
 
+def _run_host_stage(timeout):
+    """bench_host.py in a CPU-env subprocess (no TPU tunnel): TcpLB
+    tcp-splice / http-splice req/s over loopback via the native epoll
+    load tool. Returns the host_* fields or {}."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_host.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(f"# === stage host (timeout {timeout:.0f}s) ===\n")
+    p = subprocess.Popen([sys.executable,
+                          os.path.join(here, "bench_host.py")],
+                         env=env, cwd=here, stdout=sys.stderr)
+    sys.stderr.flush()
+    try:
+        p.wait(timeout)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("# stage host: timeout, SIGTERM\n")
+        p.terminate()  # child's SIGTERM handler runs its cleanup
+        try:
+            p.wait(10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write("# stage host: unkillable, abandoned\n")
+    if os.path.exists(result_file):
+        try:
+            with open(result_file) as f:
+                return json.load(f)
+        except ValueError:
+            pass
+    sys.stderr.write("# stage host: no result\n")
+    return {}
+
+
 def _read_phases(phase_file):
     out = []
     if os.path.exists(phase_file):
@@ -762,6 +801,9 @@ def orchestrate():
                             "(Host+DNS hints, LPM, ACL)",
                   "value": 0.0, "unit": "matches/s", "vs_baseline": 0.0,
                   "platform": "none", "stage": "failed"}
+    # host-path req/s (native splice pump) rides along in every run
+    result.update(_run_host_stage(
+        float(os.environ.get("BENCH_HOST_TIMEOUT", "120"))))
     result["phases"] = _read_phases(phase_file)
     print(json.dumps(result))
     return 0

@@ -1,0 +1,189 @@
+"""Host-path req/s benchmark: the native splice pump under HTTP load.
+
+BASELINE.md's haproxy-parity rows (reference wrk runs,
+/root/reference/benchmark/report/2019/06/05/bench.md:17-19: tcp-lb
+173k req/s TCP splice, 112k with L7 parsing) need a host-side answer:
+this harness drives THIS framework's TcpLB over loopback with a native
+epoll load tool (vproxy_tpu/native/hostbench.cpp — Python clients would
+measure the GIL, not the pump).
+
+Topology per mode:
+  hostbench client -> TcpLB (this framework) -> hostbench servers
+plus a direct client->server run for the machine's ceiling.
+
+Modes:
+  * direct      — no LB; the harness/loopback ceiling.
+  * tcp         — TcpLB protocol=tcp: backend picked per connection,
+                  then the C++ splice pump owns the bytes (vtl.cpp:342).
+  * http-splice — TcpLB parses the first request's Host header, picks
+                  the group via the classify queue, then splices.
+
+Prints ONE JSON line: {"host_direct_rps", "host_tcp_rps",
+"host_http_rps", ...}. bench.py merges these fields into BENCH output.
+
+Env knobs: HOSTBENCH_CONNS (64), HOSTBENCH_SECS (8), HOSTBENCH_PIPELINE
+(4), HOSTBENCH_BACKENDS (2), HOSTBENCH_WORKERS (4).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NATIVE = os.path.join(HERE, "vproxy_tpu", "native")
+BIN = os.path.join(NATIVE, "hostbench")
+
+
+def _env_int(k, d):
+    return int(os.environ.get(k, str(d)))
+
+
+def build_tool():
+    src = os.path.join(NATIVE, "hostbench.cpp")
+    if (os.path.exists(BIN)
+            and os.path.getmtime(BIN) >= os.path.getmtime(src)):
+        return
+    subprocess.check_call(["g++", "-O2", "-o", BIN, src])
+
+
+def start_server():
+    p = subprocess.Popen([BIN, "server", "0"], stdout=subprocess.PIPE,
+                         text=True)
+    line = p.stdout.readline()
+    port = json.loads(line)["listening"]
+    return p, port
+
+
+def run_client(port, conns, secs, pipeline):
+    out = subprocess.run(
+        [BIN, "client", "127.0.0.1", str(port), str(conns), str(secs),
+         str(pipeline)],
+        stdout=subprocess.PIPE, text=True, timeout=secs + 30)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    # SIGTERM (bench.py's stage timeout) must run the finally block —
+    # otherwise the native server processes are orphaned forever
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    conns = _env_int("HOSTBENCH_CONNS", 64)
+    secs = float(os.environ.get("HOSTBENCH_SECS", "8"))
+    pipeline = _env_int("HOSTBENCH_PIPELINE", 4)
+    n_backends = _env_int("HOSTBENCH_BACKENDS", 2)
+    workers = _env_int("HOSTBENCH_WORKERS", 4)
+
+    build_tool()
+    procs = []
+    result = {"host_conns": conns, "host_secs": secs,
+              "host_pipeline": pipeline, "host_workers": workers}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        # incremental: a timeout mid-stage keeps the finished sections
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f)
+            os.replace(out_path + ".tmp", out_path)
+
+    lb = None
+    elg = acceptor = None
+    groups = []
+    try:
+        backends = []
+        for _ in range(n_backends):
+            p, port = start_server()
+            procs.append(p)
+            backends.append(port)
+
+        # ceiling: client -> server direct
+        r = run_client(backends[0], conns, secs, pipeline)
+        result["host_direct_rps"] = r["rps"]
+        result["host_direct_errors"] = r["errors"]
+        flush()
+
+        from vproxy_tpu.components.elgroup import EventLoopGroup
+        from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                       ServerGroup)
+        from vproxy_tpu.components.tcplb import TcpLB
+        from vproxy_tpu.components.upstream import Upstream
+        from vproxy_tpu.rules.ir import HintRule
+
+        acceptor = EventLoopGroup("acc", 1)
+        elg = EventLoopGroup("w", workers)
+        hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1, down=2)
+        g = ServerGroup("g", elg, hc, "wrr")
+        groups.append(g)
+        for i, port in enumerate(backends):
+            g.add(f"b{i}", "127.0.0.1", port, weight=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                sum(1 for s in g.servers if s.healthy) < n_backends:
+            time.sleep(0.05)
+        healthy = sum(1 for s in g.servers if s.healthy)
+        if healthy == 0:
+            # a 0-rps "measurement" of a backend-less LB is a lie —
+            # mark the failure and skip the LB modes entirely
+            result["host_error"] = "backends never became healthy"
+            flush()
+            raise RuntimeError(result["host_error"])
+        ups = Upstream("u")
+        ups.add(g, annotations=HintRule(host="bench.example.com"))
+
+        for mode, key in (("tcp", "host_tcp_rps"),
+                          ("http-splice", "host_http_rps")):
+            lb = TcpLB(f"lb-{mode}", acceptor, elg, "127.0.0.1", 0, ups,
+                       protocol=mode)
+            lb.start()
+            try:
+                # warmup: first http-splice connections pay the classify
+                # path's one-time jit compile; keep it out of the window
+                run_client(lb.bind_port, min(conns, 4), 1.0, 1)
+                r = run_client(lb.bind_port, conns, secs, pipeline)
+                result[key] = r["rps"]
+                result[key.replace("_rps", "_errors")] = r["errors"]
+                flush()
+            finally:
+                lb.stop()
+                lb = None
+        # vs the reference's published wrk numbers on ITS hardware —
+        # context, not a same-machine comparison
+        result["host_tcp_vs_ref_173k"] = round(
+            result.get("host_tcp_rps", 0) / 173000.0, 3)
+        result["host_http_vs_ref_112k"] = round(
+            result.get("host_http_rps", 0) / 112000.0, 3)
+        flush()
+    finally:
+        if lb is not None:
+            try:
+                lb.stop()
+            except Exception:
+                pass
+        for g in groups:
+            try:
+                g.close()
+            except Exception:
+                pass
+        for h in (elg, acceptor):
+            if h is not None:
+                try:
+                    h.close()
+                except Exception:
+                    pass
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    print(json.dumps(result))
+    flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,278 @@
+// hostbench — epoll HTTP/1.1 load tool for the host-path req/s bench.
+//
+// The framework's TcpLB data path is the native splice pump
+// (vtl.cpp:342-537); measuring it through Python clients would measure
+// the GIL instead. This file provides the two native endpoints of the
+// harness (bench_host.py owns orchestration):
+//
+//   hostbench server <port>
+//       single-thread epoll HTTP server: reads until CRLFCRLF, writes a
+//       fixed keep-alive response (RESP below, constant byte length).
+//   hostbench client <ip> <port> <conns> <seconds> <pipeline>
+//       opens <conns> keep-alive connections, keeps <pipeline> requests
+//       in flight on each, counts completed responses by exact byte
+//       framing. Prints one JSON line on stdout when done.
+//
+// Both sides keep a per-connection out-buffer and flush via EPOLLOUT —
+// an EAGAIN/partial write must never drop bytes, or conns deadlock
+// under the LB's splice backpressure.
+//
+// Analog of the reference's wrk/bench.md harness
+// (benchmark/report/2019/06/05/bench.md:17-19) rebuilt self-contained.
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <string>
+
+static const char RESP[] =
+    "HTTP/1.1 200 OK\r\n"
+    "Content-Length: 13\r\n"
+    "Connection: keep-alive\r\n"
+    "\r\n"
+    "hello, world\n";
+static const size_t RESP_LEN = sizeof(RESP) - 1;
+
+static const char REQ[] =
+    "GET / HTTP/1.1\r\n"
+    "Host: bench.example.com\r\n"
+    "Connection: keep-alive\r\n"
+    "\r\n";
+static const size_t REQ_LEN = sizeof(REQ) - 1;
+
+static const int MAXFD = 65536;
+
+static int set_nonblock(int fd) {
+    int fl = fcntl(fd, F_GETFL, 0);
+    return fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+static double now_s() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+struct Conn {
+    std::string out;     // unsent bytes
+    size_t inflight = 0; // client: requests awaiting a response
+    size_t rxbytes = 0;  // client: bytes of current response received
+    size_t reqpos = 0;   // server: progress through "\r\n\r\n"
+    bool want_out = false;
+};
+
+static Conn conns[MAXFD];
+
+// flush c.out; keeps EPOLLIN|EPOLLOUT registration in sync. Returns
+// false if the connection died.
+static bool flush_out(int ep, int fd, Conn &c) {
+    while (!c.out.empty()) {
+        ssize_t w = write(fd, c.out.data(), c.out.size());
+        if (w > 0) {
+            c.out.erase(0, (size_t)w);
+            continue;
+        }
+        if (errno == EAGAIN || errno == EINTR) break;
+        return false;
+    }
+    bool want = !c.out.empty();
+    if (want != c.want_out) {
+        c.want_out = want;
+        epoll_event ev{};
+        ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
+        ev.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ev);
+    }
+    return true;
+}
+
+static void drop(int ep, int fd) {
+    epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+    close(fd);
+    conns[fd] = Conn{};
+}
+
+// --------------------------------------------------------------- server
+
+static int run_server(int port) {
+    signal(SIGPIPE, SIG_IGN);
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    sa.sin_port = htons((uint16_t)port);
+    if (bind(lfd, (sockaddr *)&sa, sizeof(sa)) != 0 || listen(lfd, 1024) != 0) {
+        perror("bind/listen");
+        return 1;
+    }
+    set_nonblock(lfd);
+    socklen_t slen = sizeof(sa);
+    getsockname(lfd, (sockaddr *)&sa, &slen);
+    printf("{\"listening\": %d}\n", ntohs(sa.sin_port));
+    fflush(stdout);
+
+    int ep = epoll_create1(0);
+    epoll_event ev{}, evs[256];
+    ev.events = EPOLLIN;
+    ev.data.fd = lfd;
+    epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+    char buf[65536];
+
+    for (;;) {
+        int n = epoll_wait(ep, evs, 256, 1000);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            if (fd == lfd) {
+                for (;;) {
+                    int cfd = accept(lfd, nullptr, nullptr);
+                    if (cfd < 0) break;
+                    if (cfd >= MAXFD) { close(cfd); continue; }
+                    set_nonblock(cfd);
+                    setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+                    conns[cfd] = Conn{};
+                    epoll_event ce{};
+                    ce.events = EPOLLIN;
+                    ce.data.fd = cfd;
+                    epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &ce);
+                }
+                continue;
+            }
+            Conn &c = conns[fd];
+            if (evs[i].events & EPOLLOUT) {
+                if (!flush_out(ep, fd, c)) { drop(ep, fd); continue; }
+            }
+            if (!(evs[i].events & (EPOLLIN | EPOLLERR | EPOLLHUP)))
+                continue;
+            ssize_t r = read(fd, buf, sizeof(buf));
+            if (r == 0 || (r < 0 && errno != EAGAIN && errno != EINTR)) {
+                drop(ep, fd);
+                continue;
+            }
+            if (r < 0) continue;
+            static const char T[] = "\r\n\r\n";
+            for (ssize_t j = 0; j < r; j++) {
+                if (buf[j] == T[c.reqpos]) {
+                    if (++c.reqpos == 4) {
+                        c.out.append(RESP, RESP_LEN);
+                        c.reqpos = 0;
+                    }
+                } else {
+                    c.reqpos = (buf[j] == '\r') ? 1 : 0;
+                }
+            }
+            if (!flush_out(ep, fd, c)) drop(ep, fd);
+        }
+    }
+    return 0;
+}
+
+// --------------------------------------------------------------- client
+
+static int run_client(const char *ip, int port, int nconn, double secs,
+                      int pipeline) {
+    signal(SIGPIPE, SIG_IGN);
+    int ep = epoll_create1(0);
+    long long done = 0, errors = 0;
+    int one = 1;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, ip, &sa.sin_addr);
+
+    for (int i = 0; i < nconn; i++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0 || fd >= MAXFD) {  // same bound guard as the server
+            if (fd >= 0) close(fd);
+            errors++;
+            continue;
+        }
+        if (connect(fd, (sockaddr *)&sa, sizeof(sa)) != 0) {
+            fprintf(stderr, "connect: %s\n", strerror(errno));
+            close(fd);
+            errors++;
+            continue;
+        }
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        set_nonblock(fd);
+        conns[fd] = Conn{};
+        epoll_event ce{};
+        ce.events = EPOLLIN;
+        ce.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ce);
+        Conn &c = conns[fd];
+        for (int p = 0; p < pipeline; p++) {
+            c.out.append(REQ, REQ_LEN);
+            c.inflight++;
+        }
+        if (!flush_out(ep, fd, c)) { drop(ep, fd); errors++; }
+    }
+
+    char buf[65536];
+    epoll_event evs[256];
+    double t0 = now_s(), tend = t0 + secs;
+    while (now_s() < tend) {
+        int n = epoll_wait(ep, evs, 256, 100);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            Conn &c = conns[fd];
+            if (evs[i].events & EPOLLOUT) {
+                if (!flush_out(ep, fd, c)) {
+                    drop(ep, fd);
+                    errors++;
+                    continue;
+                }
+            }
+            if (!(evs[i].events & (EPOLLIN | EPOLLERR | EPOLLHUP)))
+                continue;
+            ssize_t r = read(fd, buf, sizeof(buf));
+            if (r == 0 || (r < 0 && errno != EAGAIN && errno != EINTR)) {
+                drop(ep, fd);
+                errors++;
+                continue;
+            }
+            if (r < 0) continue;
+            c.rxbytes += (size_t)r;
+            while (c.rxbytes >= RESP_LEN && c.inflight > 0) {
+                c.rxbytes -= RESP_LEN;
+                c.inflight--;
+                done++;
+                c.out.append(REQ, REQ_LEN);
+                c.inflight++;
+            }
+            if (!flush_out(ep, fd, c)) {
+                drop(ep, fd);
+                errors++;
+            }
+        }
+    }
+    double el = now_s() - t0;
+    printf("{\"reqs\": %lld, \"secs\": %.3f, \"rps\": %.1f, "
+           "\"errors\": %lld, \"conns\": %d, \"pipeline\": %d}\n",
+           done, el, done / el, errors, nconn, pipeline);
+    fflush(stdout);
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc >= 3 && strcmp(argv[1], "server") == 0)
+        return run_server(atoi(argv[2]));
+    if (argc >= 7 && strcmp(argv[1], "client") == 0)
+        return run_client(argv[2], atoi(argv[3]), atoi(argv[4]),
+                          atof(argv[5]), atoi(argv[6]));
+    fprintf(stderr,
+            "usage: hostbench server <port>\n"
+            "       hostbench client <ip> <port> <conns> <secs> <pipeline>\n");
+    return 2;
+}
