@@ -1,0 +1,362 @@
+"""WebSocks advanced half: TLS/wss + SNI relay, DomainBinder +
+direct-relay, shadowsocks server, AgentDNSServer.
+
+Parity targets: WebSocksProtocolHandler.java:540 (TLS front),
+relay/DomainBinder.java:148 + relay/RelayHttpsServer.java:289
+(fake-IP direct relay), ss/SSProtocolHandler.java:196 (shadowsocks),
+AgentDNSServer.java:396 (agent caching DNS).
+"""
+import os
+import socket
+import ssl
+import struct
+import time
+
+import pytest
+
+from tests.test_tcplb import IdServer, fast_hc
+from tests.test_websocks import (USERS, mk_agent, mk_server, socks5_fetch,
+                                 stack, wait_for)
+from vproxy_tpu.components.certkey import CertKey, CertKeyHolder
+from vproxy_tpu.websocks.agent import WebSocksProxyAgent, WebSocksServerRef
+from vproxy_tpu.websocks.tls_relay import (DirectRelayServer, DomainBinder,
+                                           WebSocksTlsFrontend,
+                                           parse_client_hello_sni)
+
+SELF_DOMAIN = "ws.example.com"
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed cert for ws.example.com via the cryptography lib."""
+    from datetime import datetime, timedelta, timezone
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, SELF_DOMAIN)])
+    now = datetime.now(timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - timedelta(days=1))
+            .not_valid_after(now + timedelta(days=365))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName(SELF_DOMAIN)]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cp, kp = str(d / "cert.pem"), str(d / "key.pem")
+    with open(cp, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(kp, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption()))
+    return cp, kp
+
+
+def mk_tls_front(stack, srv, certs, **kw):
+    holder = CertKeyHolder([CertKey("ck", certs[0], certs[1])])
+    front = WebSocksTlsFrontend(srv, holder, "127.0.0.1", 0,
+                                self_domains=[SELF_DOMAIN], **kw)
+    front.start()
+    stack["close"].append(front.stop)
+    return front
+
+
+# ------------------------------------------------------------- TLS front
+
+
+def test_wss_agent_through_tls_server(stack, certs):
+    target = IdServer("S")
+    stack["close"].append(target.close)
+    srv = mk_server(stack)
+    front = mk_tls_front(stack, srv, certs)
+    elg = stack["elg"]
+    ref = WebSocksServerRef("127.0.0.1", front.bind_port, "alice",
+                            "p4ssw0rd", tls=True, tls_verify=False,
+                            tls_sni=SELF_DOMAIN)
+    agent = WebSocksProxyAgent(elg, [ref], hc=fast_hc())
+    stack["close"].append(agent.close)
+    wait_for(lambda: all(s.healthy for s in agent.group.servers),
+             msg="tls server hc")
+    got = socks5_fetch(agent.socks_port, "127.0.0.1", target.port, b"ping")
+    assert got == b"Sping"
+    assert front.terminated >= 1
+    assert srv.tunneled == 1
+
+
+def test_tls_front_rejects_garbage(stack, certs):
+    srv = mk_server(stack)
+    front = mk_tls_front(stack, srv, certs)
+    c = socket.create_connection(("127.0.0.1", front.bind_port), timeout=3)
+    c.sendall(b"GET / HTTP/1.1\r\n\r\n")  # not a ClientHello
+    c.settimeout(3)
+    assert c.recv(100) == b""  # closed
+    c.close()
+
+
+def test_sni_relay_to_foreign_site(stack, certs):
+    """SNI not ours -> raw TCP relay to (sni, relay_port): the probe
+    sees the foreign site's bytes, not our server."""
+    foreign = IdServer("F")  # raw mode: sends id then echoes
+    stack["close"].append(foreign.close)
+
+    def resolve(loop, host, cb):
+        cb("127.0.0.1" if host == "other.example.com" else None)
+
+    srv = mk_server(stack, resolve=resolve)
+    front = mk_tls_front(stack, srv, certs, relay_port=foreign.port)
+
+    ch = craft_client_hello("other.example.com")
+    c = socket.create_connection(("127.0.0.1", front.bind_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(ch)
+    got = c.recv(1 + len(ch))
+    # IdServer raw mode sends b"F" then echoes our ClientHello bytes back
+    buf = got
+    while len(buf) < 1 + len(ch):
+        d = c.recv(65536)
+        if not d:
+            break
+        buf += d
+    assert buf == b"F" + ch
+    assert front.relayed == 1
+    c.close()
+
+
+def craft_client_hello(sni: str) -> bytes:
+    """Real ClientHello bytes from the ssl library (MemoryBIO client)."""
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    bin_, bout = ssl.MemoryBIO(), ssl.MemoryBIO()
+    obj = ctx.wrap_bio(bin_, bout, server_side=False, server_hostname=sni)
+    try:
+        obj.do_handshake()
+    except ssl.SSLWantReadError:
+        pass
+    return bout.read()
+
+
+def test_parse_client_hello_sni():
+    ch = craft_client_hello("x.example.org")
+    state, sni = parse_client_hello_sni(ch)
+    assert (state, sni) == ("ok", "x.example.org")
+    # prefix -> need; garbage -> bad
+    assert parse_client_hello_sni(ch[:20])[0] == "need"
+    assert parse_client_hello_sni(b"GET / HTTP/1.1\r\n")[0] == "bad"
+
+
+# ------------------------------------------------- binder + direct relay
+
+
+def test_domain_binder_lease_cycle():
+    b = DomainBinder(ttl_s=0.2)
+    ip1 = b.bind("a.example.com")
+    assert ip1.startswith("127.")
+    assert b.bind("a.example.com") == ip1  # stable lease
+    ip2 = b.bind("b.example.com")
+    assert ip2 != ip1
+    assert b.lookup_ip(ip1) == "a.example.com"
+    assert b.lookup_ip("127.64.99.99") is None
+    time.sleep(0.25)
+    assert b.lookup_ip(ip2) is None  # expired
+
+
+def test_direct_relay_through_websocks(stack):
+    target = IdServer("D")
+    stack["close"].append(target.close)
+
+    def resolve(loop, host, cb):
+        cb("127.0.0.1" if host == "echo.example.com" else None)
+
+    srv = mk_server(stack, resolve=resolve)
+    agent = mk_agent(stack, srv)
+    binder = DomainBinder()
+    fake_ip = binder.bind("echo.example.com")
+    relay = DirectRelayServer(agent, binder, bind_port=0,
+                              target_port=target.port)
+    relay.start()
+    stack["close"].append(relay.stop)
+
+    # the OS connects to the fake IP (the whole 127/8 is loopback-local)
+    c = socket.create_connection((fake_ip, relay.bind_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"ping")
+    buf = b""
+    try:
+        while len(buf) < 5:
+            d = c.recv(65536)
+            if not d:
+                break
+            buf += d
+    except socket.timeout:
+        pass
+    assert buf == b"Dping"
+    assert relay.relayed == 1
+    assert srv.tunneled == 1
+    c.close()
+
+
+# ------------------------------------------------------------ shadowsocks
+
+
+def test_ss_server_end_to_end(stack):
+    from vproxy_tpu.websocks.ss import CfbStream, SSServer, evp_bytes_to_key
+
+    target = IdServer("Z")
+    stack["close"].append(target.close)
+    elg = stack["elg"]
+    srv = SSServer("ss", elg.next(), "127.0.0.1", 0, "sspass")
+    srv.start()
+    stack["close"].append(srv.stop)
+
+    key = evp_bytes_to_key("sspass")
+    iv = os.urandom(16)
+    enc = CfbStream(key, iv, encrypt=True)
+    c = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+    c.settimeout(5)
+    addr = b"\x01\x7f\x00\x00\x01" + struct.pack(">H", target.port)
+    c.sendall(iv + enc.update(addr + b"ping"))
+    buf = b""
+    dec = None
+    try:
+        while True:
+            d = c.recv(65536)
+            if not d:
+                break
+            buf += d
+            if dec is None and len(buf) >= 16:
+                dec = CfbStream(key, buf[:16], encrypt=False)
+                buf = dec.update(buf[16:])
+            elif dec is not None:
+                buf = buf[:-len(d)] + dec.update(d)
+            if dec is not None and len(buf) >= 5:
+                break
+    except socket.timeout:
+        pass
+    assert buf == b"Zping"
+    c.close()
+
+
+def test_ss_domain_addr_and_badtype(stack):
+    from vproxy_tpu.websocks.ss import CfbStream, SSServer, evp_bytes_to_key
+
+    target = IdServer("Y")
+    stack["close"].append(target.close)
+    elg = stack["elg"]
+
+    def resolve(loop, host, cb):
+        cb("127.0.0.1" if host == "y.example.com" else None)
+
+    srv = SSServer("ss", elg.next(), "127.0.0.1", 0, "pw2", resolve=resolve)
+    srv.start()
+    stack["close"].append(srv.stop)
+
+    key = evp_bytes_to_key("pw2")
+    iv = os.urandom(16)
+    enc = CfbStream(key, iv, encrypt=True)
+    c = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+    c.settimeout(5)
+    host = b"y.example.com"
+    addr = b"\x03" + bytes([len(host)]) + host + struct.pack(">H", target.port)
+    c.sendall(iv + enc.update(addr + b"hi"))
+    buf = b""
+    dec = None
+    try:
+        while len(buf) < 3:
+            d = c.recv(65536)
+            if not d:
+                break
+            if dec is None:
+                dec = CfbStream(key, d[:16], encrypt=False)
+                buf += dec.update(d[16:])
+            else:
+                buf += dec.update(d)
+    except socket.timeout:
+        pass
+    assert buf == b"Yhi"
+    c.close()
+
+    # bad atyp: server closes the session
+    c2 = socket.create_connection(("127.0.0.1", srv.bind_port), timeout=3)
+    iv2 = os.urandom(16)
+    enc2 = CfbStream(key, iv2, encrypt=True)
+    c2.sendall(iv2 + enc2.update(b"\x09junk"))
+    c2.settimeout(3)
+    assert c2.recv(100) == b""
+    c2.close()
+
+
+# --------------------------------------------------------- agent DNS
+
+
+def test_agent_dns_fake_and_upstream(stack):
+    from vproxy_tpu.dns import packet as P
+    from vproxy_tpu.websocks.agent import DomainChecker
+    from vproxy_tpu.websocks.agentdns import AgentDNSServer
+
+    elg = stack["elg"]
+    checker = DomainChecker(["example.com"])  # suffix rule
+    binder = DomainBinder()
+    dns = AgentDNSServer("adns", elg.next(), "127.0.0.1", 0, checker,
+                         binder,
+                         resolve=lambda d, t: ["9.9.9.9"] if t == P.A else [])
+    dns.start()
+    stack["close"].append(dns.stop)
+
+    def ask(name, qtype):
+        q = P.Packet(id=7, questions=[P.Question(qname=name + ".",
+                                                 qtype=qtype)])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(5)
+        s.sendto(q.encode(), ("127.0.0.1", dns.bind_port))
+        data, _ = s.recvfrom(4096)
+        s.close()
+        return P.parse(data)
+
+    # proxied domain -> fake IP, registered in the binder
+    r = ask("web.example.com", P.A)
+    assert r.rcode == 0 and len(r.answers) == 1
+    fake = socket.inet_ntoa(bytes(r.answers[0].rdata))
+    assert binder.lookup_ip(fake) == "web.example.com"
+    # AAAA on proxied domain: empty NOERROR (v4 fallback)
+    r = ask("web.example.com", P.AAAA)
+    assert r.rcode == 0 and not r.answers
+    # non-proxied -> upstream resolver, cached
+    r = ask("other.net", P.A)
+    assert r.rcode == 0
+    assert socket.inet_ntoa(bytes(r.answers[0].rdata)) == "9.9.9.9"
+    assert dns.upstream_answers >= 1
+    r2 = ask("other.net", P.A)
+    assert socket.inet_ntoa(bytes(r2.answers[0].rdata)) == "9.9.9.9"
+
+
+def test_wss_cert_verify_failure_fails_fast(stack, certs):
+    """tls_verify=True against a self-signed cert: the TLS handshake
+    fails BEFORE the websocks handshake starts; the front must still
+    get cb(None) (a socks failure reply), not hang (r4 review fix)."""
+    srv = mk_server(stack)
+    front = mk_tls_front(stack, srv, certs)
+    elg = stack["elg"]
+    ref = WebSocksServerRef("127.0.0.1", front.bind_port, "alice",
+                            "p4ssw0rd", tls=True, tls_verify=True,
+                            tls_sni=SELF_DOMAIN)
+    agent = WebSocksProxyAgent(elg, [ref], hc=fast_hc())
+    stack["close"].append(agent.close)
+    wait_for(lambda: all(s.healthy for s in agent.group.servers),
+             msg="hc")
+    c = socket.create_connection(("127.0.0.1", agent.socks_port), timeout=5)
+    c.settimeout(5)
+    c.sendall(b"\x05\x01\x00")
+    assert c.recv(2) == b"\x05\x00"
+    c.sendall(b"\x05\x01\x00\x01\x7f\x00\x00\x01" + struct.pack(">H", 1))
+    rep = c.recv(10)  # must answer (failure), not hang
+    assert rep[:2] == b"\x05\x05", rep
+    c.close()

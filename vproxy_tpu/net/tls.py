@@ -47,11 +47,15 @@ def install_sni_chooser(ctx: ssl.SSLContext, choose) -> None:
 
 
 class TlsSocket:
-    """TLS server-side endpoint layered on an established Connection.
-    `context` is the shared front SSLContext (built by the cert-key
-    holder, with SNI dispatch installed via install_sni_chooser)."""
+    """TLS endpoint layered on an established Connection. Server side by
+    default (`context` is the shared front SSLContext built by the
+    cert-key holder, with SNI dispatch via install_sni_chooser); with
+    server_side=False it is the CLIENT side (the agent's wss transport)
+    and emits its ClientHello immediately."""
 
-    def __init__(self, conn: Connection, context: ssl.SSLContext):
+    def __init__(self, conn: Connection, context: ssl.SSLContext,
+                 server_side: bool = True,
+                 server_hostname: Optional[str] = None):
         self.conn = conn
         self.loop = conn.loop
         self.remote = conn.remote
@@ -66,8 +70,12 @@ class TlsSocket:
         self._pending_plain = bytearray()  # writes queued during handshake
         self._in = ssl.MemoryBIO()
         self._out = ssl.MemoryBIO()
-        self._obj = context.wrap_bio(self._in, self._out, server_side=True)
+        self._obj = context.wrap_bio(self._in, self._out,
+                                     server_side=server_side,
+                                     server_hostname=server_hostname)
         conn.set_handler(_RawTlsHandler(self))
+        if not server_side:
+            self._step()  # drive the ClientHello into the out-BIO
 
     # ----------------------------------------------- Connection-like api
 
@@ -105,6 +113,12 @@ class TlsSocket:
 
     def resume_reading(self) -> None:
         self.conn.resume_reading()
+
+    def feed_raw(self, data: bytes) -> None:
+        """Inject ciphertext that was consumed from the Connection BEFORE
+        this TlsSocket took it over (an SNI sniffer's buffered bytes)."""
+        self._in.write(data)
+        self._step()
 
     # -------------------------------------------------------- internals
 

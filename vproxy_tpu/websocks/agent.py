@@ -80,13 +80,28 @@ class DomainChecker:
 
 class WebSocksServerRef:
     def __init__(self, host: str, port: int, user: str, password: str,
-                 kcp: bool = False, weight: int = 10):
+                 kcp: bool = False, weight: int = 10, tls: bool = False,
+                 tls_verify: bool = True,
+                 tls_sni: Optional[str] = None):
         self.host = host
         self.port = port
         self.user = user
         self.password = password
         self.kcp = kcp
         self.weight = weight
+        self.tls = tls            # wss:// — TLS to the websocks server
+        self.tls_verify = tls_verify
+        self.tls_sni = tls_sni or host
+        self._ctx = None
+
+    def client_ctx(self):
+        """One SSLContext per server ref: creating one per tunnel would
+        re-load the CA bundle on the loop thread for every connection
+        and discard TLS session-resumption state."""
+        if self._ctx is None:
+            from ..net.tls import client_context
+            self._ctx = client_context(verify=self.tls_verify)
+        return self._ctx
 
 
 class _KcpTransport:
@@ -417,34 +432,54 @@ class _HandshakeMachine:
 
 
 class _TcpTunnel(_Tunnel):
+    """Plain-TCP or TLS (wss) transport to the websocks server. In TLS
+    mode `self.conn` is the TlsSocket (same write/close surface) and the
+    tunnel never upgrades to the native pump — the TLS state lives in
+    Python (WebSocksProxyAgentConnectorProvider.java:826's SSL branch).
+    """
+
     @staticmethod
     def open(agent: WebSocksProxyAgent, ref: WebSocksServerRef,
              host: str, port: int, cb) -> None:
         try:
-            conn = Connection.connect(agent.loop, ref.host, ref.port)
+            raw = Connection.connect(agent.loop, ref.host, ref.port)
         except OSError:
             cb(None)
             return
+        if ref.tls:
+            from ..net.tls import TlsSocket
+            conn = TlsSocket(raw, ref.client_ctx(),
+                             server_side=False, server_hostname=ref.tls_sni)
+        else:
+            conn = raw
         t = _TcpTunnel()
         t.conn = conn
+        t._tls = ref.tls
         hs_req = _socks5_connect_req(host, port)
 
         class H(Handler):
             def __init__(self):
                 self.hs: Optional[_HandshakeMachine] = None
+                self.notified = False  # cb fired (tunnel or None)
 
             def on_connected(self, c):
                 self.hs = _HandshakeMachine(ref, c.write, hs_req,
                                             self._done)
 
             def _done(self, ok: bool, leftover: bytes) -> None:
+                # hs cleared FIRST: c.close() below re-enters via
+                # on_closed -> _dead, which must not re-run the machine
+                self.hs = None
+                if self.notified:
+                    return
+                self.notified = True
                 if not ok:
                     c = t.conn
                     t.conn = None
-                    c.close()
+                    if c is not None:
+                        c.close()
                     cb(None)
                     return
-                self.hs = None
                 if leftover:
                     t._emit(leftover)
                 cb(t)
@@ -465,6 +500,12 @@ class _TcpTunnel(_Tunnel):
                 if self.hs is not None:
                     hs, self.hs = self.hs, None
                     hs.done(False, b"")
+                elif not self.notified:
+                    # died before the handshake even started (TCP
+                    # refusal after connect(), TLS handshake/verify
+                    # failure) — the front must still hear about it
+                    self.notified = True
+                    cb(None)
                 else:
                     t._emit_closed()
 
@@ -479,6 +520,8 @@ class _TcpTunnel(_Tunnel):
             self.conn.close()
 
     def pump_fd(self) -> Optional[int]:
+        if getattr(self, "_tls", False):
+            return None  # TLS state is Python-resident: no pump handover
         if self.conn is None or self.conn.closed or self.conn.detached \
                 or self.conn.out:
             return None
