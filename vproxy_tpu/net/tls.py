@@ -122,7 +122,27 @@ class TlsSocket:
 
     # -------------------------------------------------------- internals
 
+    def _mirror(self, data: bytes, outbound: bool) -> None:
+        """vmirror "ssl" origin: the only place decrypted bytes exist
+        (Mirror.java's SSL-plaintext tap)."""
+        from ..utils.ip import parse_ip
+        from ..utils.mirror import Mirror
+        try:
+            rip = parse_ip(self.remote[0])
+        except (ValueError, TypeError):
+            rip = b"\x00\x00\x00\x00"
+        rport = self.remote[1] if self.remote else 0
+        if outbound:
+            Mirror.get().mirror("ssl", data, src_ip=None, dst_ip=rip,
+                                dst_port=rport)
+        else:
+            Mirror.get().mirror("ssl", data, src_ip=rip, dst_ip=None,
+                                src_port=rport)
+
     def _write_plain(self, data: bytes) -> None:
+        from ..utils.mirror import Mirror
+        if Mirror.get().hot:
+            self._mirror(data, outbound=True)
         try:
             view = memoryview(data)
             while view:
@@ -183,6 +203,9 @@ class TlsSocket:
                 self.handler.on_eof(self)
                 return
             self.bytes_in += len(plain)
+            from ..utils.mirror import Mirror
+            if Mirror.get().hot:
+                self._mirror(plain, outbound=False)
             self.handler.on_data(self, plain)
         self._flush_out()
 

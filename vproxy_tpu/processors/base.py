@@ -112,6 +112,7 @@ class TcpRelaySession(ProtoSession):
 
     def __init__(self, engine: ProcessorEngine, client_addr, hint_fn=None):
         self.engine = engine
+        self.client_addr = client_addr
         self.hint_fn = hint_fn
         self.back: Optional[int] = None
 
@@ -125,12 +126,32 @@ class TcpRelaySession(ProtoSession):
                 return None
         return self.back
 
+    def _mirror(self, data: bytes, outbound: bool) -> None:
+        from ..utils.ip import parse_ip
+        from ..utils.mirror import Mirror
+        addr = self.client_addr
+        try:
+            cip = parse_ip(addr[0]) if addr else b"\x00\x00\x00\x00"
+        except ValueError:
+            cip = b"\x00\x00\x00\x00"
+        cport = addr[1] if addr else 0
+        if outbound:
+            Mirror.get().mirror("proxy", data, dst_ip=cip, dst_port=cport)
+        else:
+            Mirror.get().mirror("proxy", data, src_ip=cip, src_port=cport)
+
     def on_front_data(self, data: bytes) -> None:
+        from ..utils.mirror import Mirror
+        if Mirror.get().hot:
+            self._mirror(data, outbound=False)
         back = self._ensure()
         if back is not None:
             self.engine.send_back(back, data)
 
     def on_back_data(self, conn_id: int, data: bytes) -> None:
+        from ..utils.mirror import Mirror
+        if Mirror.get().hot:
+            self._mirror(data, outbound=True)
         self.engine.send_front(data)
 
     def on_back_eof(self, conn_id: int) -> None:

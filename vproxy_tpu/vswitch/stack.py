@@ -37,6 +37,12 @@ class NetworkStack:
         if net is None:
             return
         ether = pkt.ether
+        from ..utils.mirror import Mirror
+        mir = Mirror.get()
+        # wants() (not just .active/.hot) BEFORE serializing: an ssl-only
+        # config must not tax the forwarding path with to_bytes()
+        if mir.hot and mir.wants("switch"):
+            Mirror.get().mirror("switch", ether.to_bytes(), raw_ether=True)
         if not _is_multicast(ether.src):
             net.macs.record(ether.src, src_iface)
         if _is_multicast(ether.dst):

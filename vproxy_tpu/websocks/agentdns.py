@@ -47,7 +47,10 @@ class AgentDNSServer:
         self.queries = 0
         self.fake_answers = 0
         self.upstream_answers = 0
-        self._cache: dict = {}  # name -> (ips, expiry)
+        self._cache: dict = {}  # (name, qtype) -> (ips, expiry)
+        # in-flight dedup: one resolver thread per name; concurrent
+        # queries (OS resolvers retry aggressively) join the waiters
+        self._inflight: dict = {}  # (name, qtype) -> [(req, ip, port)]
 
     # ---------------------------------------------------------- lifecycle
 
@@ -88,10 +91,16 @@ class AgentDNSServer:
             # AAAA for a proxied domain: empty NOERROR -> v4 fallback
             self._respond(req, ip, port, answers)
             return
-        ent = self._cache.get((domain, q.qtype))
+        key = (domain, q.qtype)
+        ent = self._cache.get(key)
         if ent is not None and ent[1] > time.monotonic():
             self._answer_ips(req, ip, port, q, ent[0])
             return
+        waiters = self._inflight.get(key)  # loop-confined state
+        if waiters is not None:
+            waiters.append((req, ip, port))
+            return
+        self._inflight[key] = [(req, ip, port)]
 
         def work() -> None:
             try:
@@ -101,9 +110,15 @@ class AgentDNSServer:
 
             def deliver() -> None:
                 if ips:
-                    self._cache[(domain, q.qtype)] = (
-                        ips, time.monotonic() + CACHE_TTL)
-                self._answer_ips(req, ip, port, q, ips)
+                    if len(self._cache) > 4096:  # bound: drop expired
+                        now = time.monotonic()
+                        for k in [k for k, v in self._cache.items()
+                                  if v[1] < now]:
+                            del self._cache[k]
+                    self._cache[key] = (ips, time.monotonic() + CACHE_TTL)
+                for w_req, w_ip, w_port in self._inflight.pop(key, []):
+                    self._answer_ips(w_req, w_ip, w_port,
+                                     w_req.questions[0], ips)
 
             if not self.loop.run_on_loop(deliver):
                 pass  # loop gone: drop

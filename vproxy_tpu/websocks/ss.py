@@ -92,6 +92,13 @@ class _SSSession(Handler):
             self.early.extend(plain)
         else:
             self.back.write(plain)
+            if self.back.out:  # backpressure: pause the faster side
+                self.conn.pause_reading()
+
+    def on_drained(self, c: Connection) -> None:
+        # client out-buffer flushed: resume the backend
+        if self.back_up and not self.dead:
+            self.back.resume_reading()
 
     def on_eof(self, c: Connection) -> None:
         self._close()
@@ -173,6 +180,12 @@ class _SSSession(Handler):
             def on_data(self, bc: Connection, data: bytes) -> None:
                 if sess.enc is not None and not sess.dead:
                     sess.conn.write(sess.enc.update(data))
+                    if sess.conn.out:  # backpressure on a slow client
+                        bc.pause_reading()
+
+            def on_drained(self, bc: Connection) -> None:
+                if not sess.dead:
+                    sess.conn.resume_reading()
 
             def on_eof(self, bc: Connection) -> None:
                 sess._close()
