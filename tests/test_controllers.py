@@ -146,9 +146,13 @@ def test_http_controller_crud(app):
         _, body = http_get_id(app.tcp_lbs["lb0"].bind_port, "y.io")
         assert body == "H1"
         st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/tcp-lb")
-        assert st == 200 and any("lb0" in line for line in r)
+        assert st == 200 and any(d["name"] == "lb0" for d in r)
+        st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/tcp-lb/lb0")
+        assert st == 200 and r["backend"] == "ups0" \
+            and r["protocol"] == "http"
         st, r = http_req(ctl.bind_port, "GET", "/api/v1/module/server-group/sg0/server")
-        assert st == 200 and "currently UP" in r[0]
+        assert st == 200 and r[0]["name"] == "s1" \
+            and r[0]["currentlyUp"] is True
         st, r = http_req(ctl.bind_port, "DELETE", "/api/v1/module/tcp-lb/lb0")
         assert st == 200
         assert app.tcp_lbs == {}

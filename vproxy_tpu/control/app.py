@@ -39,6 +39,11 @@ class Application:
         self.dns_servers: dict[str, DNSServer] = {}
         self.cert_keys: dict[str, object] = {}
         self.switches: dict[str, object] = {}
+        self.resp_controllers: dict[str, object] = {}
+        self.http_controllers: dict[str, object] = {}
+        # (switch alias, vni) -> {"ip:port": VpcProxy}
+        self.vpc_proxies: dict[tuple, dict] = {}
+        self._resolver = None  # lazy "(default)" resolver
 
         self.elgs[DEFAULT_CONTROL_ELG] = EventLoopGroup(DEFAULT_CONTROL_ELG, 1)
         worker = EventLoopGroup(DEFAULT_WORKER_ELG, workers)
@@ -49,6 +54,26 @@ class Application:
     @property
     def control_loop(self):
         return self.elgs[DEFAULT_CONTROL_ELG].loops[0]
+
+    def get_resolver(self):
+        """The "(default)" resolver singleton (AbstractResolver analog):
+        TTL-cached, nameservers from /etc/resolv.conf."""
+        if self._resolver is None:
+            from ..dns.client import DNSClient, Resolver
+            ns = []
+            try:
+                with open("/etc/resolv.conf") as f:
+                    for line in f:
+                        parts = line.split()
+                        if len(parts) >= 2 and parts[0] == "nameserver":
+                            ns.append((parts[1], 53))
+            except OSError:
+                pass
+            if not ns:
+                ns = [("127.0.0.53", 53), ("8.8.8.8", 53)]
+            self._resolver = Resolver(
+                self.control_loop, DNSClient(self.control_loop, ns))
+        return self._resolver
 
     @property
     def worker_elg(self) -> EventLoopGroup:

@@ -62,6 +62,11 @@ TYPES = {
     "bytes-out": "bytes-out", "bout": "bytes-out",
     "accepted-conn-count": "accepted-conn-count",
     "dns-cache": "dns-cache",
+    "resolver": "resolver",
+    "proxy": "proxy",
+    "resp-controller": "resp-controller",
+    "http-controller": "http-controller",
+    "docker-network-plugin-controller": "docker-network-plugin-controller",
 }
 
 PARAM_KEYS = {
@@ -673,6 +678,10 @@ def _h_switch(app: Application, c: Command):
                 raise CmdError(f"remote switch {c.alias!r} not found")
             return "OK"
         sw = _need(app.switches, c.alias, "switch")
+        # vpc proxies bound to this switch die with it
+        for key in [k for k in app.vpc_proxies if k[0] == c.alias]:
+            for p in app.vpc_proxies.pop(key).values():
+                p.close()
         sw.stop()
         del app.switches[c.alias]
         return "OK"
@@ -702,9 +711,12 @@ def _h_vpc(app: Application, c: Command):
                 for n in sw.networks.values()]
     if c.action in ("remove", "force-remove"):
         try:
-            sw.del_network(int(c.alias))
+            vni = int(c.alias)
+            sw.del_network(vni)
         except (KeyError, ValueError):
             raise CmdError(f"vpc {c.alias!r} not found")
+        for p in app.vpc_proxies.pop((sw.alias, vni), {}).values():
+            p.close()
         return "OK"
     raise CmdError(f"unsupported action {c.action} for vpc")
 
@@ -902,7 +914,143 @@ def _h_stats(app: Application, c: Command):
     raise CmdError(f"unsupported stat {c.type}")
 
 
+def _h_resolver(app: Application, c: Command):
+    """The reference's resolver is a singleton named "(default)"
+    (ResolverHandle.java:10-16); dns-cache lives inside it."""
+    if c.action in ("list", "list-detail"):
+        return ["(default)"]
+    raise CmdError(f"unsupported action {c.action} for resolver")
+
+
+def _h_dnscache(app: Application, c: Command):
+    ctx = c.target or (c.contexts[0] if c.contexts else None)
+    if ctx is not None and (ctx[0] != "resolver" or ctx[1] != "(default)"):
+        raise CmdError("dns-cache lives in `resolver (default)`")
+    res = app.get_resolver()
+    if c.action == "list":
+        return sorted({k[0] for k in res._cache})
+    if c.action == "list-detail":
+        import time as _t
+        now = _t.monotonic()
+        out = []
+        for (name, qtype), (expiry, addrs) in sorted(res._cache.items()):
+            from ..utils.ip import format_ip
+            out.append(f"{name} -> [{','.join(format_ip(bytes(a)) for a in addrs)}]"
+                       f" ttl={max(0, int(expiry - now))}")
+        return out
+    if c.action in ("remove", "force-remove"):
+        gone = [k for k in res._cache if k[0] == c.alias]
+        if not gone:
+            raise CmdError(f"dns-cache {c.alias!r} not found")
+        for k in gone:
+            del res._cache[k]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for dns-cache")
+
+
+def _h_proxy(app: Application, c: Command):
+    """`add proxy <ip:port> to vpc <vni> in switch <sw> address <tgt>`
+    — in-VPC user-space listener bridged to a host address
+    (vswitch/ProxyHolder)."""
+    from ..vswitch.proxy import VpcProxy
+
+    sw, net = _ctx_vpc(app, c)  # validates the vpc exists in the switch
+    key = (sw.alias, net.vni)
+    store = app.vpc_proxies.get(key, {})
+    if c.action == "add":
+        if c.alias in store:
+            raise CmdError(f"proxy {c.alias} already exists")
+        lip, lport = _addr(c.alias)
+        if "address" not in c.params:
+            raise CmdError("proxy requires `address <target ip:port>`")
+        tip, tport = _addr(c.params["address"])
+        try:
+            p = VpcProxy(sw, net.vni, lip, lport, tip, tport)
+        except OSError as e:
+            raise CmdError(f"proxy listen failed: {e}")
+        app.vpc_proxies.setdefault(key, {})[c.alias] = p
+        return "OK"
+    if c.action == "list":
+        return list(store.keys())
+    if c.action == "list-detail":
+        return [f"{p.alias} -> {p.target[0]}:{p.target[1]} "
+                f"sessions={p.sessions}" for p in store.values()]
+    if c.action in ("remove", "force-remove"):
+        p = _need(store, c.alias, "proxy")
+        p.close()
+        del store[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for proxy")
+
+
+def _h_respc(app: Application, c: Command):
+    from .resp import RESPController
+    if c.action == "add":
+        if c.alias in app.resp_controllers:
+            raise CmdError(f"resp-controller {c.alias} already exists")
+        if "address" not in c.params:
+            raise CmdError("resp-controller requires `address <ip:port>`")
+        ip, port = _addr(c.params["address"])
+        ctl = RESPController(app, ip, port,
+                             password=c.params.get("password"))
+        ctl.start()
+        app.resp_controllers[c.alias] = ctl
+        return "OK"
+    if c.action == "list":
+        return list(app.resp_controllers.keys())
+    if c.action == "list-detail":
+        return [f"{a} -> {ctl.bind_ip}:{ctl.bind_port}"
+                for a, ctl in app.resp_controllers.items()]
+    if c.action in ("remove", "force-remove"):
+        ctl = _need(app.resp_controllers, c.alias, "resp-controller")
+        ctl.stop()
+        del app.resp_controllers[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for resp-controller")
+
+
+def _h_httpc(app: Application, c: Command):
+    from .http_controller import HttpController
+    if c.action == "add":
+        if c.alias in app.http_controllers:
+            raise CmdError(f"http-controller {c.alias} already exists")
+        if "address" not in c.params:
+            raise CmdError("http-controller requires `address <ip:port>`")
+        ip, port = _addr(c.params["address"])
+        ctl = HttpController(app, ip, port)
+        ctl.start()
+        app.http_controllers[c.alias] = ctl
+        return "OK"
+    if c.action == "list":
+        return list(app.http_controllers.keys())
+    if c.action == "list-detail":
+        return [f"{a} -> {ctl.bind_ip}:{ctl.bind_port}"
+                for a, ctl in app.http_controllers.items()]
+    if c.action in ("remove", "force-remove"):
+        ctl = _need(app.http_controllers, c.alias, "http-controller")
+        ctl.stop()
+        del app.http_controllers[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for http-controller")
+
+
+def _h_docker(app: Application, c: Command):
+    """Recognized for grammar parity; the docker libnetwork plugin host
+    (unix-socket HTTP driver, DockerNetworkDriverImpl.java:421) is
+    explicitly descoped in this build — SURVEY §2.7 analog."""
+    if c.action in ("list", "list-detail"):
+        return []
+    raise CmdError("docker-network-plugin-controller is descoped in this "
+                   "build (no docker libnetwork plugin host)")
+
+
 _HANDLERS = {
+    "resolver": _h_resolver,
+    "dns-cache": _h_dnscache,
+    "proxy": _h_proxy,
+    "resp-controller": _h_respc,
+    "http-controller": _h_httpc,
+    "docker-network-plugin-controller": _h_docker,
     "event-loop-group": _h_elg,
     "event-loop": _h_el,
     "upstream": _h_ups,

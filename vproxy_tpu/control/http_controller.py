@@ -23,8 +23,102 @@ MODULES = {
     "upstream": "upstream", "server-group": "server-group",
     "security-group": "security-group", "cert-key": "cert-key",
     "switch": "switch",
+    "resp-controller": "resp-controller",
+    "http-controller": "http-controller",
 }
 FLAG_KEYS = {"allow-non-backend", "deny-non-backend"}
+
+
+def _anno(rule) -> dict:
+    d = {}
+    if getattr(rule, "host", None) is not None:
+        d["vproxy/hint-host"] = rule.host
+    if getattr(rule, "port", 0):
+        d["vproxy/hint-port"] = str(rule.port)
+    if getattr(rule, "uri", None) is not None:
+        d["vproxy/hint-uri"] = rule.uri
+    return d
+
+
+# typed list-detail JSON per module (HttpController.java:59-320 returns
+# per-resource objects, doc/api.yaml schemas) — built straight from the
+# component objects, not by re-parsing command-grammar strings
+def _details(app, rtype: str) -> list:
+    if rtype == "tcp-lb":
+        return [{
+            "name": a, "address": f"{lb.bind_ip}:{lb.bind_port}",
+            "protocol": lb.protocol, "backend": lb.backend.alias,
+            "securityGroup": lb.security_group.alias,
+            "inBufferSize": lb.in_buffer_size, "timeout": lb.timeout_ms,
+            "activeSessions": getattr(lb, "active_sessions", 0),
+            "listOfCertKey": [ck.alias for ck in lb.cert_keys],
+        } for a, lb in app.tcp_lbs.items()]
+    if rtype == "socks5-server":
+        return [{
+            "name": a, "address": f"{s.bind_ip}:{s.bind_port}",
+            "backend": s.backend.alias,
+            "securityGroup": s.security_group.alias,
+            "allowNonBackend": getattr(s, "allow_non_backend", False),
+        } for a, s in app.socks5_servers.items()]
+    if rtype == "dns-server":
+        return [{
+            "name": a, "address": f"{d.bind_ip}:{d.bind_port}",
+            "rrsets": d.rrsets.alias, "ttl": d.ttl,
+            "securityGroup": d.security_group.alias,
+            "queries": getattr(d, "queries", 0),
+        } for a, d in app.dns_servers.items()]
+    if rtype == "event-loop-group":
+        return [{"name": a, "eventLoopList": elg.loop_names()}
+                for a, elg in app.elgs.items()]
+    if rtype == "upstream":
+        return [{
+            "name": a, "serverGroupList": [{
+                "name": h.alias, "weight": h.weight,
+                "annotations": _anno(h.annotations),
+            } for h in u.handles],
+        } for a, u in app.upstreams.items()]
+    if rtype == "server-group":
+        return [{
+            "name": a, "method": g.method,
+            "timeout": g.hc.timeout_ms, "period": g.hc.period_ms,
+            "up": g.hc.up, "down": g.hc.down,
+            "protocol": g.hc.protocol,
+            "annotations": _anno(g.annotations),
+            "serverList": [{
+                "name": s.name, "address": f"{s.ip}:{s.port}",
+                "weight": s.weight, "currentlyUp": s.healthy,
+                "connCount": getattr(s, "conn_count", 0),
+            } for s in g.servers],
+        } for a, g in app.server_groups.items()]
+    if rtype == "security-group":
+        return [{
+            "name": a,
+            "defaultRule": "allow" if sg.default_allow else "deny",
+            "ruleList": [{
+                "name": r.alias,
+                "network": f"{r.network}",
+                "protocol": r.protocol.value,
+                "portRange": f"{r.min_port},{r.max_port}",
+                "rule": "allow" if r.allow else "deny",
+            } for r in sg.rules],
+        } for a, sg in app.security_groups.items()]
+    if rtype == "cert-key":
+        return [{"name": a, "cert": ck.cert_path, "key": ck.key_path,
+                 "dnsNames": ck.dns_names}
+                for a, ck in app.cert_keys.items()]
+    if rtype == "switch":
+        return [{
+            "name": a, "address": f"{sw.bind_ip}:{sw.bind_port}",
+            "vpcList": sorted(sw.networks.keys()),
+            "ifaceCount": len(sw.list_ifaces()),
+        } for a, sw in app.switches.items()]
+    if rtype == "resp-controller":
+        return [{"name": a, "address": f"{c.bind_ip}:{c.bind_port}"}
+                for a, c in app.resp_controllers.items()]
+    if rtype == "http-controller":
+        return [{"name": a, "address": f"{c.bind_ip}:{c.bind_port}"}
+                for a, c in app.http_controllers.items()]
+    raise CmdError(f"no detail view for {rtype}")
 
 
 class HttpController:
@@ -95,19 +189,27 @@ class HttpController:
                 toks += [k, str(v)]
         return " ".join(toks)
 
+    # GET /module/{name}/<sub> answers from the typed object directly
+    SUB_KEYS = {"server": "serverList", "server-group": "serverGroupList",
+                "security-group-rule": "ruleList",
+                "event-loop": "eventLoopList"}
+
     def _dispatch(self, method: str, rtype: str, name, sub, body: bytes):
         app = self.app
         if method == "GET":
+            details = _details(app, rtype)
             if name is None:
-                return 200, Command.execute(app, f"list-detail {rtype}")
-            if sub:
-                return 200, Command.execute(
-                    app, f"list-detail {sub[0]} in {rtype} {name}")
-            detail = Command.execute(app, f"list-detail {rtype}")
-            for line in detail:
-                if line.split(" ")[0] == name:
-                    return 200, {"name": name, "detail": line}
-            return 404, {"error": f"{rtype} {name} not found"}
+                return 200, details
+            obj = next((d for d in details if d["name"] == name), None)
+            if obj is None:
+                return 404, {"error": f"{rtype} {name} not found"}
+            if not sub or sub == ["detail"]:
+                return 200, obj
+            key = self.SUB_KEYS.get(sub[0])
+            if key is not None and key in obj:
+                return 200, obj[key]
+            return 200, Command.execute(
+                app, f"list-detail {sub[0]} in {rtype} {name}")
         if method == "POST":
             params = json.loads(body or b"{}")
             if name is None:

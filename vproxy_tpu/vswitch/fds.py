@@ -90,6 +90,11 @@ class VConn:
     def write(self, data: bytes) -> None:
         self.conn.write(data)
 
+    @property
+    def out(self) -> bytes:
+        """Unsent bytes (backpressure signal, like Connection.out)."""
+        return self.conn.pending
+
     def shutdown_write(self) -> None:
         self.conn.shutdown_write()
 

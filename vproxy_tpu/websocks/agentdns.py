@@ -110,11 +110,18 @@ class AgentDNSServer:
 
             def deliver() -> None:
                 if ips:
-                    if len(self._cache) > 4096:  # bound: drop expired
+                    if len(self._cache) > 4096:  # hard bound
                         now = time.monotonic()
                         for k in [k for k, v in self._cache.items()
                                   if v[1] < now]:
                             del self._cache[k]
+                        # lookup storm of fresh entries: evict oldest
+                        overflow = len(self._cache) - 4096
+                        if overflow > 0:
+                            for k in sorted(self._cache,
+                                            key=lambda k: self._cache[k][1]
+                                            )[:overflow]:
+                                del self._cache[k]
                     self._cache[key] = (ips, time.monotonic() + CACHE_TTL)
                 for w_req, w_ip, w_port in self._inflight.pop(key, []):
                     self._answer_ips(w_req, w_ip, w_port,
