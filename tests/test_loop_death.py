@@ -1,0 +1,248 @@
+"""Component auto-restart on event-loop death.
+
+Parity: reference LBAttach (TcpLB.java:45-66) and DNSServer
+EventLoopAttach (DNSServer.java:89-106): when the loop hosting a
+resource's bindings dies — crash or removal — the resource re-homes
+onto a surviving loop of its group instead of going dark.
+"""
+import socket
+import struct
+import time
+
+import pytest
+
+from tests.test_tcplb import IdServer, fast_hc, wait_healthy
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.servergroup import ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.rules.ir import HintRule
+
+
+def crash_loop(lp, timeout=5.0):
+    """Simulate an abnormal loop death: make the poll machinery raise
+    (callbacks are guarded; one_poll itself is not)."""
+    def boom():
+        raise RuntimeError("injected loop crash")
+    lp.one_poll = boom
+    # wake the native poll: the loop may be sleeping and would only see
+    # the patched one_poll on its next iteration
+    lp.run_on_loop(lambda: None)
+    t0 = time.time()
+    while lp._thread.is_alive() and time.time() - t0 < timeout:
+        time.sleep(0.01)
+    assert not lp._thread.is_alive(), "loop thread did not die"
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise TimeoutError(msg)
+        time.sleep(0.02)
+
+
+@pytest.fixture
+def stack():
+    objs = {"close": []}
+    yield objs
+    for c in reversed(objs["close"]):
+        try:
+            c()
+        except Exception:
+            pass
+
+
+def fetch(port, payload=b"ping", tries=3):
+    last = None
+    for _ in range(tries):
+        try:
+            c = socket.create_connection(("127.0.0.1", port), timeout=3)
+            c.settimeout(3)
+            c.sendall(payload)
+            buf = b""
+            while len(buf) < 1 + len(payload):
+                d = c.recv(4096)
+                if not d:
+                    break
+                buf += d
+            c.close()
+            return buf
+        except OSError as e:
+            last = e
+            time.sleep(0.1)
+    raise last
+
+
+def mk_lb(stack, n_acceptor=2):
+    target = IdServer("R")
+    stack["close"].append(target.close)
+    acc = EventLoopGroup("acc", n_acceptor)
+    work = EventLoopGroup("wrk", 1)
+    stack["close"].append(acc.close)
+    stack["close"].append(work.close)
+    g = ServerGroup("g", work, fast_hc(), "wrr")
+    stack["close"].append(g.close)
+    g.add("t", "127.0.0.1", target.port, weight=1)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g, annotations=HintRule(host="x"))
+    lb = TcpLB("lb", acc, work, "127.0.0.1", 0, ups, protocol="tcp")
+    lb.start()
+    stack["close"].append(lb.stop)
+    return lb, acc
+
+
+def test_tcplb_rehomes_on_acceptor_crash(stack):
+    lb, acc = mk_lb(stack)
+    assert fetch(lb.bind_port) == b"Rping"
+    victim = lb.server_socks[0].loop
+    crash_loop(victim)
+    wait_for(lambda: len(acc.loops) == 1, msg="group dropped dead loop")
+    # the listener was re-bound onto the surviving loop
+    wait_for(lambda: len(lb.server_socks) == 2
+             and all(ss.loop is not victim for ss in lb.server_socks),
+             msg="re-home")
+    for _ in range(6):  # new connections keep being served
+        assert fetch(lb.bind_port) == b"Rping"
+
+
+def test_tcplb_rehomes_on_remove_loop(stack):
+    lb, acc = mk_lb(stack)
+    victim = lb.server_socks[0].loop
+    name = next(k for k, v in acc._loops.items() if v is victim)
+    acc.remove_loop(name)
+    wait_for(lambda: all(ss.loop is not victim for ss in lb.server_socks),
+             msg="re-home after remove_loop")
+    for _ in range(4):
+        assert fetch(lb.bind_port) == b"Rping"
+
+
+def test_dns_server_rehomes_on_crash(stack):
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.dns.server import DNSServer
+    from vproxy_tpu.dns import packet as P
+
+    elg = EventLoopGroup("dns", 2)
+    stack["close"].append(elg.close)
+    work = EventLoopGroup("dnsw", 1)
+    stack["close"].append(work.close)
+    g = ServerGroup("g", work, fast_hc(), "wrr")
+    stack["close"].append(g.close)
+    g.add("a", "10.9.9.9", 80, weight=1)
+    g.servers[0].healthy = True
+    ups = Upstream("rr")
+    ups.add(g, annotations=HintRule(host="svc.example.com"))
+    srv = DNSServer("d", elg.next(), "127.0.0.1", 0, ups, elg=elg)
+    srv.start()
+    stack["close"].append(srv.stop)
+
+    def ask():
+        q = P.Packet(id=3, questions=[P.Question(qname="svc.example.com.",
+                                                 qtype=P.A)])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(3)
+        s.sendto(q.encode(), ("127.0.0.1", srv.bind_port))
+        try:
+            data, _ = s.recvfrom(4096)
+        finally:
+            s.close()
+        r = P.parse(data)
+        return [bytes(a.rdata) for a in r.answers]
+
+    assert ask() == [bytes([10, 9, 9, 9])]
+    victim = srv.loop
+    crash_loop(victim)
+    wait_for(lambda: srv.loop is not victim and srv.started,
+             msg="dns re-home")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        try:
+            assert ask() == [bytes([10, 9, 9, 9])]
+            break
+        except socket.timeout:
+            continue
+    else:
+        raise AssertionError("dns never answered after re-home")
+
+
+def test_switch_rehomes_on_crash(stack):
+    from vproxy_tpu.utils.ip import Network, parse_ip
+    from vproxy_tpu.vswitch import packets as P
+    from vproxy_tpu.vswitch.switch import Switch, synthetic_mac
+
+    elg = EventLoopGroup("sw", 2)
+    stack["close"].append(elg.close)
+    sw = Switch("sw0", elg.next(), "127.0.0.1", 0, elg=elg)
+    stack["close"].append(sw.stop)
+    sw.add_network(9, Network.parse("10.9.0.0/16"))
+    sw.start()
+    # give the VPC a synthetic IP the switch answers ARP for
+    sw.networks[9].ips.add(parse_ip("10.9.0.1"),
+                           synthetic_mac(9, parse_ip("10.9.0.1")))
+
+    def arp_probe():
+        arp = P.Arp(P.ARP_REQUEST, sha=b"\x02" * 6,
+                    spa=parse_ip("10.9.0.2"), tha=b"\x00" * 6,
+                    tpa=parse_ip("10.9.0.1"))
+        e = P.Ethernet(b"\xff" * 6, b"\x02" * 6, P.ETHER_TYPE_ARP, b"", arp)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(2)
+        s.sendto(P.Vxlan(9, e).to_bytes(), ("127.0.0.1", sw.bind_port))
+        try:
+            data, _ = s.recvfrom(4096)
+        except socket.timeout:
+            return None
+        finally:
+            s.close()
+        vx = P.Vxlan.parse(data)
+        return vx.ether.packet.op if isinstance(vx.ether.packet, P.Arp) \
+            else None
+
+    assert arp_probe() == P.ARP_REPLY
+    victim = sw.loop
+    crash_loop(victim)
+    wait_for(lambda: sw.loop is not victim and sw.started,
+             msg="switch re-home")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if arp_probe() == P.ARP_REPLY:
+            break
+    else:
+        raise AssertionError("switch never answered after re-home")
+
+
+def test_dns_server_rehomes_on_graceful_remove(stack):
+    """Graceful remove_loop: death callbacks must fire AFTER the dead
+    loop released the UDP fd, or the same-port re-bind EADDRINUSEs
+    (r4 review finding)."""
+    from vproxy_tpu.dns.server import DNSServer
+    from vproxy_tpu.dns import packet as P
+
+    elg = EventLoopGroup("dnsg", 2)
+    stack["close"].append(elg.close)
+    work = EventLoopGroup("dnsgw", 1)
+    stack["close"].append(work.close)
+    g = ServerGroup("g", work, fast_hc(), "wrr")
+    stack["close"].append(g.close)
+    g.add("a", "10.8.8.8", 80, weight=1)
+    g.servers[0].healthy = True
+    ups = Upstream("rr")
+    ups.add(g, annotations=HintRule(host="svc.example.com"))
+    srv = DNSServer("d", elg.next(), "127.0.0.1", 0, ups, elg=elg)
+    srv.start()
+    stack["close"].append(srv.stop)
+    victim = srv.loop
+    name = next(k for k, v in elg._loops.items() if v is victim)
+    elg.remove_loop(name)
+    assert srv.started and srv.loop is not victim
+
+    q = P.Packet(id=4, questions=[P.Question(qname="svc.example.com.",
+                                             qtype=P.A)])
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(3)
+    s.sendto(q.encode(), ("127.0.0.1", srv.bind_port))
+    data, _ = s.recvfrom(4096)
+    s.close()
+    assert [bytes(a.rdata) for a in P.parse(data).answers] == \
+        [bytes([10, 8, 8, 8])]

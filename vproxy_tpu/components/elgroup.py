@@ -8,6 +8,7 @@ N worker loops.
 from __future__ import annotations
 
 import itertools
+import traceback
 from typing import Optional
 
 from ..net.eventloop import SelectorEventLoop
@@ -34,9 +35,29 @@ class EventLoopGroup:
         if name in self._loops:
             raise ValueError(f"event-loop {name} already exists in {self.name}")
         lp = SelectorEventLoop(name)
+        lp.on_death.append(self._loop_died)
         lp.loop_thread()
         self._loops[name] = lp
         return lp
+
+    def _loop_died(self, lp: SelectorEventLoop) -> None:
+        """A member loop stopped (crash or close). Unless the whole group
+        is shutting down, attached resources re-home their bindings —
+        the reference's LBAttach / DNSServer EventLoopAttach semantics
+        (TcpLB.java:45-66, DNSServer.java:89-106)."""
+        if self._closed:
+            return
+        for k, v in list(self._loops.items()):
+            if v is lp:
+                del self._loops[k]
+        for r in list(self._resources):
+            cb = getattr(r, "on_loop_death", None)
+            if cb is None:
+                continue
+            try:
+                cb(self, lp)
+            except Exception:
+                traceback.print_exc()
 
     def remove_loop(self, name: str) -> None:
         lp = self._loops.pop(name, None)
@@ -54,7 +75,8 @@ class EventLoopGroup:
         return loops[next(self._rr) % len(loops)]
 
     def attach(self, resource) -> None:
-        self._resources.append(resource)
+        if resource not in self._resources:
+            self._resources.append(resource)
 
     def detach(self, resource) -> None:
         if resource in self._resources:

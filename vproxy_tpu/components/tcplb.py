@@ -23,11 +23,14 @@ from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
 from ..utils.ip import parse_ip
+from ..utils.log import Logger
 from .elgroup import EventLoopGroup
 from .l7 import L7Engine
 from .secgroup import SecurityGroup
 from .servergroup import Connector
 from .upstream import Upstream
+
+_log = Logger("tcp-lb")
 
 
 class TcpLB:
@@ -69,10 +72,43 @@ class TcpLB:
 
     # ------------------------------------------------------------ control
 
+    def on_loop_death(self, group, lp) -> None:
+        """LBAttach semantics (TcpLB.java:45-66): an acceptor loop died —
+        forget its listener (the dying loop already closed the fd) and
+        bind a replacement on a surviving loop so capacity recovers."""
+        if group is not self.acceptor or not self.started:
+            return
+        dead = [ss for ss in self.server_socks if ss.loop is lp]
+        if not dead:
+            return
+        self.server_socks = [ss for ss in self.server_socks
+                             if ss.loop is not lp]
+        if not group.loops:
+            return  # nowhere to re-home; stop() semantics apply
+        try:
+            nlp = group.next()
+
+            def mk() -> None:
+                if not self.started:  # raced a concurrent stop()
+                    return
+                self.server_socks.append(ServerSock(
+                    nlp, self.bind_ip, self.bind_port,
+                    lambda fd, ip, port, lp=nlp: self._on_accept(
+                        lp, fd, ip, port),
+                    reuseport=True))
+            nlp.call_sync(mk)
+            if not self.started:  # stop() raced the re-home: undo
+                for ss in self.server_socks:
+                    ss.loop.run_on_loop(ss.close)
+                self.server_socks = []
+        except OSError as e:
+            _log.alert(f"tcp-lb {self.alias}: re-home bind failed: {e!r}")
+
     def start(self) -> None:
         if self.started:
             return
         self.started = True
+        self.acceptor.attach(self)
         loops = self.acceptor.loops
         # bind loops one at a time so an ephemeral port (bind_port=0) is
         # resolved once and the remaining loops share it via REUSEPORT
@@ -98,6 +134,7 @@ class TcpLB:
         if not self.started:
             return
         self.started = False
+        self.acceptor.detach(self)
         for ss in self.server_socks:
             ss.loop.run_on_loop(ss.close)
         self.server_socks = []

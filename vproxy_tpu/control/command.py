@@ -589,7 +589,7 @@ def _h_dns(app: Application, c: Command):
         ups = _need(app.upstreams, c.params["upstream"], "upstream")
         elg = _opt_elg(app, c, "elg", app.worker_elg)
         secg = _opt_secg(app, c)
-        d = DNSServer(c.alias, elg.next(), ip, port, ups,
+        d = DNSServer(c.alias, elg.next(), ip, port, ups, elg=elg,
                       ttl=int(c.params.get("ttl", 0)), security_group=secg)
         d.start()
         app.dns_servers[c.alias] = d
@@ -657,7 +657,7 @@ def _h_switch(app: Application, c: Command):
                                                           300_000)),
                     arp_table_timeout_ms=int(c.params.get("arp-table-timeout",
                                                           4 * 3600_000)),
-                    bare_vxlan_access=secg)
+                    bare_vxlan_access=secg, elg=elg)
         sw.start()
         app.switches[c.alias] = sw
         return "OK"

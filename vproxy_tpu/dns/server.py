@@ -27,7 +27,7 @@ class DNSServer:
                  bind_port: int, rrsets: Upstream, ttl: int = 0,
                  security_group: Optional[SecurityGroup] = None,
                  recursive_client: Optional[DNSClient] = None,
-                 hosts: Optional[dict[str, bytes]] = None):
+                 hosts: Optional[dict[str, bytes]] = None, elg=None):
         self.alias = alias
         self.loop = loop
         self.bind_ip = bind_ip
@@ -38,6 +38,7 @@ class DNSServer:
         self.recursive = recursive_client
         self.hosts = hosts or {}
         self._fd: Optional[int] = None
+        self.elg = elg  # attach target for loop-death re-homing
         self.started = False
         self.queries = 0
 
@@ -46,22 +47,56 @@ class DNSServer:
     def start(self) -> None:
         if self.started:
             return
+        self._bind(self.loop)
+        if self.elg is not None:
+            self.elg.attach(self)
+        self.started = True
 
+    def _bind(self, loop) -> None:
         def mk() -> None:
             self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
             if self.bind_port == 0:
                 _, self.bind_port = vtl.sock_name(self._fd)
-            self.loop.add(self._fd, vtl.EV_READ, self._on_readable)
+            loop.add(self._fd, vtl.EV_READ, self._on_readable)
         try:
-            self.loop.call_sync(mk)
+            loop.call_sync(mk)
         except OSError as e:
             raise OSError(f"dns-server {self.alias}: bind failed: {e}") from e
-        self.started = True
+
+    def on_loop_death(self, group, lp) -> None:
+        """DNSServer.java:89-106: when the hosting loop dies, re-home
+        the UDP bind onto a surviving loop of the attached group (death
+        callbacks fire after the dead loop released our fd)."""
+        if lp is not self.loop or not self.started:
+            return
+        self._fd = None
+        if not group.loops:
+            self.started = False
+            group.detach(self)
+            return
+        self.loop = group.next()
+        try:
+            self._bind(self.loop)
+        except OSError:
+            self.started = False
+            group.detach(self)
+            return
+        if not self.started:  # raced a concurrent stop(): undo the bind
+            fd, self._fd = self._fd, None
+            lp2 = self.loop
+
+            def rm() -> None:
+                if fd is not None:
+                    lp2.remove(fd)
+                    vtl.close(fd)
+            lp2.run_on_loop(rm)
 
     def stop(self) -> None:
         if not self.started:
             return
         self.started = False
+        if self.elg is not None:
+            self.elg.detach(self)
         fd = self._fd
         self._fd = None
 

@@ -99,6 +99,10 @@ class SelectorEventLoop:
         self._taggen = itertools.count(1)
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # fired (once, on the dying thread) when the loop stops running —
+        # graceful close OR crash. EventLoopGroup re-homes resources here
+        # (reference LBAttach / DNSServer.java:89-106 semantics).
+        self.on_death: list = []
         self.now = time.monotonic()
         self._tags_buf = (ctypes.c_uint64 * MAX_EVENTS)()
         self._evs_buf = (ctypes.c_uint32 * MAX_EVENTS)()
@@ -273,8 +277,26 @@ class SelectorEventLoop:
         if self._closed:  # close() raced the thread start: undo
             gi.deregister_loop(self)
             return
-        while not self._closed:
-            self.one_poll()
+        try:
+            while not self._closed:
+                self.one_poll()
+        except Exception:
+            # the loop machinery itself died (callbacks are guarded —
+            # this is a poll/queue bug or fd catastrophe). Mark closed so
+            # writers stop, release fds + the native loop (close() would
+            # early-return on the _closed flag), then notify. Death
+            # callbacks fire strictly AFTER fd cleanup so re-homing can
+            # re-bind the same addresses; the graceful path fires them
+            # from close() with the same ordering.
+            import sys
+            import traceback
+            print(f"event loop {self.name} CRASHED:", file=sys.stderr)
+            traceback.print_exc()
+            with self._xq_lock:
+                self._closed = True
+            gi.deregister_loop(self)
+            self._cleanup_native()
+            self._fire_death()
 
     def loop_thread(self) -> threading.Thread:
         th = threading.Thread(target=self.loop, name=self.name, daemon=True)
@@ -299,7 +321,23 @@ class SelectorEventLoop:
                 print(f"loop {self.name}: thread did not exit; leaking native "
                       f"loop", file=sys.stderr)
                 return
+        self._cleanup_native()
+        self._fire_death()
+
+    def _fire_death(self) -> None:
+        """Fire-once death notification. Always AFTER _cleanup_native:
+        subscribers re-bind the addresses the dead loop just released."""
+        cbs, self.on_death = self.on_death, []
+        for cb in cbs:
+            _guard(cb, self)
+
+    def _cleanup_native(self) -> None:
+        """Release fds + the native loop and honor promised tasks. Runs
+        on the closing thread (graceful) or the dying loop thread
+        (crash); _closed is already set so no new registrations race."""
         lp = self._lp
+        if lp is None:
+            return
         self._lp = None
         for fd in list(self._fd_tags):
             self._fd_tags.pop(fd, None)

@@ -42,9 +42,10 @@ class Switch:
                  mac_table_timeout_ms: int = MAC_TABLE_TIMEOUT,
                  arp_table_timeout_ms: int = ARP_TABLE_TIMEOUT,
                  bare_vxlan_access: Optional[SecurityGroup] = None,
-                 matcher_backend: Optional[str] = None):
+                 matcher_backend: Optional[str] = None, elg=None):
         self.alias = alias
         self.loop = loop
+        self.elg = elg  # attach target for loop-death re-homing
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.mac_table_timeout_ms = mac_table_timeout_ms
@@ -66,24 +67,67 @@ class Switch:
     def start(self) -> None:
         if self.started:
             return
+        self._bind(self.loop)
+        if self.elg is not None:
+            self.elg.attach(self)
+        self.started = True
 
+    def _bind(self, loop) -> None:
         def mk() -> None:
             self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
             if self.bind_port == 0:
                 _, self.bind_port = vtl.sock_name(self._fd)
-            self.loop.add(self._fd, vtl.EV_READ, self._on_readable)
-            self._sweeper = self.loop.period(IFACE_TIMEOUT_MS // 4,
-                                             self._sweep_ifaces)
+            loop.add(self._fd, vtl.EV_READ, self._on_readable)
+            self._sweeper = loop.period(IFACE_TIMEOUT_MS // 4,
+                                        self._sweep_ifaces)
         try:
-            self.loop.call_sync(mk)
+            loop.call_sync(mk)
         except OSError as e:
             raise OSError(f"switch {self.alias}: bind failed: {e}") from e
-        self.started = True
+
+    def on_loop_death(self, group, lp) -> None:
+        """Re-home the switch's VXLAN sock onto a surviving loop when
+        the hosting loop dies. VPC state and MAC/ARP tables are plain
+        host memory and survive; IFACES whose fds/timers lived on the
+        dead loop are dropped from the registry WITHOUT close() — the
+        dead loop already released their fds, and closing the stale fd
+        numbers could hit unrelated reused descriptors. Peers re-appear
+        through the normal learning path."""
+        if lp is not self.loop or not self.started:
+            return
+        self._fd = None
+        self._sweeper = None
+        for key, (iface, _) in list(self.ifaces.items()):
+            del self.ifaces[key]
+            for net in self.networks.values():
+                net.macs.remove_iface(iface)
+        if not group.loops:
+            self.started = False
+            group.detach(self)
+            return
+        self.loop = group.next()
+        try:
+            self._bind(self.loop)
+        except OSError:
+            self.started = False
+            group.detach(self)
+            return
+        if not self.started:  # raced a concurrent stop(): undo the bind
+            fd, self._fd = self._fd, None
+            lp2 = self.loop
+
+            def rm() -> None:
+                if fd is not None:
+                    lp2.remove(fd)
+                    vtl.close(fd)
+            lp2.run_on_loop(rm)
 
     def stop(self) -> None:
         if not self.started:
             return
         self.started = False
+        if self.elg is not None:
+            self.elg.detach(self)
         fd = self._fd
         self._fd = None
 
