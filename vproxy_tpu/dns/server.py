@@ -18,8 +18,11 @@ from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import Hint, Proto
 from ..utils.ip import is_ip_literal, parse_ip
+from ..utils.log import Logger
 from . import packet as P
 from .client import DNSClient
+
+_log = Logger("dns-server")
 
 
 class DNSServer:
@@ -77,7 +80,9 @@ class DNSServer:
         self.loop = group.next()
         try:
             self._bind(self.loop)
-        except OSError:
+        except OSError as e:
+            _log.alert(f"dns-server {self.alias}: re-home bind failed: "
+                       f"{e!r}; server is down")
             self.started = False
             group.detach(self)
             return

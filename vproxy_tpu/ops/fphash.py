@@ -319,6 +319,13 @@ def compile_hint_fp(rules: Sequence[HintRule],
                          lambda i: _host_member(rules[i], i, lset_pos, usalts))
     uri_rec = _fill_rec(uri_cap, uE, uM, uslots, uri_buckets,
                         lambda i: _uri_member(rules[i], i, hsalts))
+    # ONE combined slot table: uri slots live at host_cap + slot (the
+    # encoder applies the offset), so the kernel fetches all host+uri
+    # probe rows in a single gather instead of two
+    rw = max(host_rec.shape[1], uri_rec.shape[1])
+    rec = np.zeros((host_cap + uri_cap, rw), np.int32)
+    rec[:host_cap, : host_rec.shape[1]] = host_rec
+    rec[host_cap:, : uri_rec.shape[1]] = uri_rec
 
     whc = max(caps.get("whc", 0), _pow2(max(len(wh), 1), 2))
     wuc = max(caps.get("wuc", 0), _pow2(max(len(wu), 1), 2))
@@ -335,7 +342,7 @@ def compile_hint_fp(rules: Sequence[HintRule],
     lset_arr[: len(lset)] = lset
 
     arrays = {
-        "host_rec": host_rec, "uri_rec": uri_rec,
+        "rec": rec,
         "wh_rec": wh_rec, "wu_rec": wu_rec,
         "lset": lset_arr,
         "rcap_iota": np.zeros(r_cap, np.int32),
@@ -426,7 +433,9 @@ def encode_hint_queries_fp(hints: Sequence, tab: FpHintTable) -> dict:
         q_has_uri[:, None]
     ll = np.where(lv, np.maximum(lset[None, :], 0), 0)
     umask = np.uint32(tab.uri_cap - 1)
-    up_slot = np.where(lv, np.take_along_axis(us[0], ll, 1) & umask, 0)
+    # uri slots are offset into the combined host+uri slot table
+    up_slot = np.where(
+        lv, (np.take_along_axis(us[0], ll, 1) & umask) + tab.host_cap, 0)
     up_fp1 = np.where(lv, np.take_along_axis(us[1], ll, 1), 0)
     up_fp2 = np.where(lv, np.take_along_axis(us[2], ll, 1), 0)
     up_score = np.where(lv, np.minimum(ll + 1, URI_MAX_SCORE), 0)
@@ -506,8 +515,11 @@ def hint_fp_match(t: dict, q: dict):
         lv = jnp.where((idx >= 0) & pg, level, 0)
         cands.append((lv.reshape(b, -1), idx.reshape(b, -1)))
 
-    # ---- host-table probes: [B, P] rows -> entries -> members
-    hrows = t["host_rec"][q["hp_slot"]].reshape(b, -1, hE, 2 + 4 * hM)
+    # ---- ALL probe rows (host + offset uri slots) in ONE gather
+    p_cnt = q["hp_slot"].shape[1]
+    rows = t["rec"][jnp.concatenate([q["hp_slot"], q["up_slot"]], axis=1)]
+    hew, uew = 2 + 4 * hM, 2 + 4 * uM
+    hrows = rows[:, :p_cnt, : hE * hew].reshape(b, -1, hE, hew)
     h_ok = (hrows[..., 0] == q["hp_fp1"][:, :, None]) & \
         (hrows[..., 1] == q["hp_fp2"][:, :, None]) & \
         (q["hp_level"][:, :, None] > 0)
@@ -518,8 +530,8 @@ def hint_fp_match(t: dict, q: dict):
     add(jnp.where(h_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
         jnp.where(h_ok[..., None], midx, -1), mport)
 
-    # ---- uri-table probes: [B, Lc] rows
-    urows = t["uri_rec"][q["up_slot"]].reshape(b, -1, uE, 2 + 4 * uM)
+    # ---- uri-probe rows (same gather, offset slots)
+    urows = rows[:, p_cnt:, : uE * uew].reshape(b, -1, uE, uew)
     u_ok = (urows[..., 0] == q["up_fp1"][:, :, None]) & \
         (urows[..., 1] == q["up_fp2"][:, :, None]) & \
         (q["up_score"][:, :, None] > 0)
@@ -624,15 +636,19 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
         n6 = _pow2(len(g6), 4)
     g_cap = n4 + n6
 
+    mk = 1
     if acl is not None:
         for buckets in groups.values():
             for k in buckets:
                 buckets[k] = _prune_acl_members(buckets[k], acl)
-    # route entries collapse to (fp, min idx); ACL rules sharing one
-    # network become one 4-lane entry EACH (same fp, own port range) —
-    # the entry axis absorbs members, keeping rows narrow under the
-    # TPU's pad-last-dim-to-128 tiling
-    ew = 3 if acl is None else 4
+                mk = max(mk, len(buckets[k]))
+    # both modes use 3-lane slot entries: route = (fp, fp, min idx);
+    # ACL = (fp, fp, member-row id) with the (idx, port-range) members
+    # in a SECOND narrow table — a query reads the slot row for every
+    # group but member rows only for its (single) fp-matched key,
+    # instead of every co-slotted key's members
+    Mk = max(caps.get("Mk", 0), mk)
+    ew = 3
 
     g_mask4 = np.zeros((g_cap, 4), np.uint32)
     g_fam = np.full(g_cap, -1, np.int32)
@@ -649,11 +665,17 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
     for gi, (fam, mask) in order:
         buckets = groups[(fam, mask)]
         cap = _pow2(2 * max(len(buckets), 1), 4)
-        salts, slots = _place_fp(list(buckets.keys()), _fnv32_key16, cap,
-                                 salt_base=101 + gi)
-        e_need = max(e_need, max(
-            (sum(1 if acl is None else len(buckets[k])
-                 for k, _, _ in v) for v in slots.values()), default=1))
+        # E (entries per slot row) sets the gathered row WIDTH for the
+        # whole table — the dominant per-query HBM cost. Grow a group's
+        # slot cap until co-slotted keys stop stacking.
+        while True:
+            salts, slots = _place_fp(list(buckets.keys()), _fnv32_key16,
+                                     cap, salt_base=101 + gi)
+            e_here = max((len(v) for v in slots.values()), default=1)
+            if e_here <= 4 or cap >= 64 * len(buckets):
+                break
+            cap *= 2
+        e_need = max(e_need, e_here)
         g_mask4[gi] = _pack_words16(np.frombuffer(mask, np.uint8))
         g_fam[gi] = fam
         g_salt[0][gi], g_salt[1][gi], g_salt[2][gi] = salts
@@ -665,26 +687,29 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
     E = max(caps.get("E", 0), e_need)
     if E > 128:
         raise FpBuildError(f"degenerate slot pileup: E={E}")
+    n_keys = sum(len(groups[k]) for k in groups)
+    nm = max(caps.get("nm", 0), _pow2(n_keys + 1, 256))
     ct = max(caps.get("ct", 0), _pow2(max(off, 1), 256))
     rec = np.zeros((ct, E * ew), np.int32)
+    mrows = np.full((nm if acl is not None else 1, 2 * Mk), -1, np.int32)
+    next_mrow = 1  # row 0 = empty (all idx -1)
     for gi, cap, salts, slots, buckets in placed:
         base_off = g_off[gi]
         for sl, ents in slots.items():
             row = base_off + sl
-            j = 0
-            for key, f1, f2 in ents:
+            for j, (key, f1, f2) in enumerate(ents):
                 if acl is None:
                     rec[row, j * ew: j * ew + 3] = [
                         _i32(f1), _i32(f2), min(buckets[key])]
-                    j += 1
                     continue
-                for ridx in buckets[key]:
+                mrow = next_mrow
+                next_mrow += 1
+                for mi, ridx in enumerate(buckets[key]):
                     r = acl[ridx]
-                    rec[row, j * ew: j * ew + 4] = [
-                        _i32(f1), _i32(f2), ridx,
-                        _i32((r.min_port & 0xFFFF) |
-                             ((r.max_port & 0xFFFF) << 16))]
-                    j += 1
+                    mrows[mrow, 2 * mi] = ridx
+                    mrows[mrow, 2 * mi + 1] = _i32(
+                        (r.min_port & 0xFFFF) | ((r.max_port & 0xFFFF) << 16))
+                rec[row, j * ew: j * ew + 3] = [_i32(f1), _i32(f2), mrow]
 
     allow = np.zeros(r_cap, bool)
     if acl is not None:
@@ -699,7 +724,10 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
         "rcap_iota": np.zeros(r_cap, np.int32),
         "e_m": np.zeros((E, 1), np.int32),
     }
-    new_caps = {"r_cap": r_cap, "n4": n4, "n6": n6, "E": E, "ct": ct}
+    if acl is not None:
+        arrays["mrows"] = mrows
+    new_caps = {"r_cap": r_cap, "n4": n4, "n6": n6, "E": E, "ct": ct,
+                "Mk": Mk, "nm": nm}
     if caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
                     for k in new_caps):
         raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
@@ -732,19 +760,25 @@ def cidr_fp_match(t: dict, addr16: jnp.ndarray, fam: jnp.ndarray,
     ents = rows.reshape(b, -1, E, ew)
     eok = (ents[..., 0] == f1[:, :, None]) & (ents[..., 1] == f2[:, :, None]) \
         & gok[:, :, None]
-    if ew == 3:  # route mode: entry carries its bucket's min rule index
+    if "mrows" not in t:  # route: entry carries its bucket's min index
         idx = jnp.where(eok, ents[..., 2], r_cap)
         first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
         return jnp.where(first < r_cap, first, -1)
-    # ACL mode: one rule per 4-lane entry (fp, fp, idx, lo|hi<<16)
-    valid = eok
+    # ACL: entry carries a member-row id; at most ONE entry per group
+    # matches (distinct keys under one mask), so the per-group winner
+    # reduces to a single member-row gather of (idx, lo|hi<<16) pairs
+    mrow = jnp.max(jnp.where(eok, ents[..., 2], 0), axis=2)  # [B, G]
+    mem = t["mrows"][mrow]  # [B, G, 2*Mk] — narrow second-level gather
+    mem = mem.reshape(b, mrow.shape[1], -1, 2)
+    midx = mem[..., 0]
+    valid = midx >= 0
     if port is not None:
-        ports = ents[..., 3]
+        ports = mem[..., 1]
         lo = ports & 0xFFFF
         hi = (ports >> 16) & 0xFFFF
         p = port[:, None, None]
         valid = valid & (lo <= p) & (p <= hi)
-    idx = jnp.where(valid, ents[..., 2], r_cap)
+    idx = jnp.where(valid, midx, r_cap)
     first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
     return jnp.where(first < r_cap, first, -1)
 

@@ -19,6 +19,7 @@ from ..components.secgroup import SecurityGroup
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import Proto
+from ..utils.log import Logger
 from ..utils.ip import Network, parse_ip
 from .iface import (BareVXLanIface, Iface, RemoteSwitchIface, TapIface,
                     UserClientIface, UserIface, tap_supported)
@@ -26,6 +27,8 @@ from .network import ARP_TABLE_TIMEOUT, MAC_TABLE_TIMEOUT, VpcNetwork
 from .packets import (PacketError, VPROXY_TYPE_PING, VPROXY_TYPE_VXLAN,
                       VProxySwitchPacket, Vxlan)
 from .stack import NetworkStack
+
+_log = Logger("switch")
 
 IFACE_TIMEOUT_MS = 60_000  # Switch.java:630
 
@@ -88,19 +91,25 @@ class Switch:
     def on_loop_death(self, group, lp) -> None:
         """Re-home the switch's VXLAN sock onto a surviving loop when
         the hosting loop dies. VPC state and MAC/ARP tables are plain
-        host memory and survive; IFACES whose fds/timers lived on the
-        dead loop are dropped from the registry WITHOUT close() — the
-        dead loop already released their fds, and closing the stale fd
-        numbers could hit unrelated reused descriptors. Peers re-appear
-        through the normal learning path."""
+        host memory and survive. Ifaces:
+
+        * fd-less (bare-vxlan / remote-switch / user server side) —
+          survive untouched; their traffic rides the re-homed sock;
+        * user-client — re-arms its keepalive periodic on the new loop;
+        * tap — its /dev/net/tun fd died with the loop and is dropped
+          from the registry WITHOUT close() (the dead loop released the
+          fd; closing the stale number could hit a reused descriptor).
+        """
+        from .iface import TapIface, UserClientIface
         if lp is not self.loop or not self.started:
             return
         self._fd = None
         self._sweeper = None
-        for key, (iface, _) in list(self.ifaces.items()):
-            del self.ifaces[key]
-            for net in self.networks.values():
-                net.macs.remove_iface(iface)
+        for key, (iface, ts) in list(self.ifaces.items()):
+            if isinstance(iface, TapIface):
+                del self.ifaces[key]
+                for net in self.networks.values():
+                    net.macs.remove_iface(iface)
         if not group.loops:
             self.started = False
             group.detach(self)
@@ -108,19 +117,31 @@ class Switch:
         self.loop = group.next()
         try:
             self._bind(self.loop)
-        except OSError:
+        except OSError as e:
+            _log.alert(f"switch {self.alias}: re-home bind failed: {e!r}; "
+                       f"switch is down")
             self.started = False
             group.detach(self)
             return
+        for _key, (iface, _ts) in list(self.ifaces.items()):
+            if isinstance(iface, UserClientIface):
+                iface._periodic = None  # old timer died with the loop
+                iface.attach(self)
         if not self.started:  # raced a concurrent stop(): undo the bind
-            fd, self._fd = self._fd, None
-            lp2 = self.loop
+            self._undo_rehome_bind()
 
-            def rm() -> None:
-                if fd is not None:
-                    lp2.remove(fd)
-                    vtl.close(fd)
-            lp2.run_on_loop(rm)
+    def _undo_rehome_bind(self) -> None:
+        fd, self._fd = self._fd, None
+        sweeper, self._sweeper = self._sweeper, None
+        lp2 = self.loop
+
+        def rm() -> None:
+            if sweeper is not None:
+                sweeper.cancel()
+            if fd is not None:
+                lp2.remove(fd)
+                vtl.close(fd)
+        lp2.run_on_loop(rm)
 
     def stop(self) -> None:
         if not self.started:
