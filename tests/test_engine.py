@@ -49,11 +49,11 @@ def test_graft_entry_single():
     import __graft_entry__ as g
     import jax
     fn, args = g.entry()
-    out = jax.jit(fn)(*args)
-    h_idx = np.asarray(out[0])
-    assert h_idx.shape == (256,)
-    # spot-check one element against the oracle path
-    assert (h_idx >= -1).all()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.shape == (256, 3)  # [B, (hint, route, acl)] packed i32
+    assert (out >= -1).all()
+    # the hint column must land real matches (queries target the rules)
+    assert (out[:, 0] >= 0).any()
 
 
 def test_graft_dryrun_multichip():

@@ -1,0 +1,147 @@
+"""wrap/blocking + wrap/file FDs and DHCP DNS discovery.
+
+Parity: BlockingDatagramFD.java:364, wrap/file/FileFD.java:22,
+dhcp/DHCPClientHelper.java:27-180.
+"""
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.dns import dhcp
+from vproxy_tpu.net.connection import Handler
+from vproxy_tpu.net.wrapfd import BlockingUdp, FileConn
+
+
+@pytest.fixture
+def loop():
+    elg = EventLoopGroup("wf", 1)
+    yield elg.next()
+    elg.close()
+
+
+def test_blocking_udp_roundtrip(loop):
+    b = BlockingUdp(loop, "127.0.0.1", 0)
+    try:
+        peer = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        peer.bind(("127.0.0.1", 0))
+        pport = peer.getsockname()[1]
+        # blocking recv on a plain thread while the loop feeds the queue
+        b.send(b"ping", "127.0.0.1", pport)
+        data, addr = peer.recvfrom(100)
+        assert data == b"ping"
+        peer.sendto(b"pong", ("127.0.0.1", b.local[1]))
+        data, ip, port = b.recv(timeout=5)
+        assert data == b"pong" and port == pport
+        with pytest.raises(TimeoutError):
+            b.recv(timeout=0.05)
+        peer.close()
+    finally:
+        b.close()
+
+
+def test_file_conn_streams_and_backpressure(tmp_path, loop):
+    p = tmp_path / "payload.bin"
+    blob = os.urandom(200_000)
+    p.write_bytes(blob)
+    got = bytearray()
+    events = {"eof": threading.Event(), "paused_at": None}
+    fc = FileConn(loop, str(p))
+
+    class H(Handler):
+        def on_data(self, c, data):
+            got.extend(data)
+            if events["paused_at"] is None and len(got) >= 65536:
+                events["paused_at"] = len(got)
+                c.pause_reading()
+                loop.delay(50, c.resume_reading)
+
+        def on_eof(self, c):
+            events["eof"].set()
+            c.close()
+
+        def on_closed(self, c, err):
+            events["eof"].set()
+
+    assert fc.length == len(blob)
+    fc.set_handler(H())
+    assert events["eof"].wait(10)
+    assert bytes(got) == blob
+    assert events["paused_at"] is not None  # backpressure exercised
+
+
+def fake_dhcp_server(dns_ips):
+    """Minimal DHCP responder on an ephemeral loopback port."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+    def serve():
+        s.settimeout(10)
+        try:
+            data, addr = s.recvfrom(2048)
+        except OSError:
+            return
+        (xid,) = struct.unpack(">I", data[4:8])
+        head = struct.pack(">BBBBIHH", 2, 1, 6, 0, xid, 0, 0)
+        head += b"\x00" * 16 + data[28:44] + b"\x00" * 192
+        opts = bytes([dhcp.OPT_MSG_TYPE, 1, dhcp.OFFER,
+                      dhcp.OPT_DNS, 4 * len(dns_ips)])
+        for ip in dns_ips:
+            opts += socket.inet_aton(ip)
+        opts += bytes([dhcp.OPT_END])
+        s.sendto(head + dhcp.MAGIC + opts, addr)
+        s.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return port
+
+
+def test_dhcp_discovers_dns_servers(loop):
+    port = fake_dhcp_server(["10.0.0.53", "10.0.0.54"])
+    out = {}
+    done = threading.Event()
+
+    def cb(found, err):
+        out["found"], out["err"] = found, err
+        done.set()
+
+    dhcp.get_dns_servers(loop, cb, server=("127.0.0.1", port),
+                         bind_ip="127.0.0.1", timeout_ms=1500)
+    assert done.wait(5)
+    assert out["err"] is None
+    assert out["found"] == {socket.inet_aton("10.0.0.53"),
+                            socket.inet_aton("10.0.0.54")}
+
+
+def test_dhcp_timeout_reports_error(loop):
+    out = {}
+    done = threading.Event()
+    # a port nobody answers on
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    dhcp.get_dns_servers(loop, lambda f, e: (out.update(f=f, e=e),
+                                             done.set()),
+                         server=("127.0.0.1", port),
+                         bind_ip="127.0.0.1", timeout_ms=300, retries=0)
+    assert done.wait(5)
+    s.close()
+    assert out["f"] == set() and isinstance(out["e"], TimeoutError)
+
+
+def test_dhcp_codec_roundtrip():
+    pkt = dhcp.build_discover(0xAABBCCDD)
+    assert pkt[0] == 1 and pkt[236:240] == dhcp.MAGIC
+    # reply parser rejects foreign xid and non-reply ops
+    assert dhcp.parse_reply(pkt, 0xAABBCCDD) is None  # a request, not reply
+    head = struct.pack(">BBBBIHH", 2, 1, 6, 0, 7, 0, 0) + b"\x00" * 224
+    opts = bytes([dhcp.OPT_MSG_TYPE, 1, dhcp.ACK, dhcp.OPT_DNS, 4,
+                  1, 2, 3, 4, dhcp.OPT_END])
+    data = head + dhcp.MAGIC + opts
+    assert dhcp.parse_reply(data, 7) == [bytes([1, 2, 3, 4])]
+    assert dhcp.parse_reply(data, 8) is None
