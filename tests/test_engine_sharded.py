@@ -231,3 +231,92 @@ def test_e2e_tcplb_sockets_over_sharded_backend(mesh):
             s.close()
         elg.close()
         ClassifyService.reset()
+
+
+# ---------------- jax-fp-sharded: the fp kernels over the same mesh
+
+
+def test_hint_matcher_fp_sharded_parity(mesh):
+    rules = mk_rules(300)
+    m = HintMatcher(rules, backend="jax-fp-sharded", mesh=mesh)
+    hints = mk_queries(rules, 96)
+    got = m.match(hints)
+    for i, h in enumerate(hints):
+        assert got[i] == oracle.search(rules, h), (i, h)
+
+
+def test_hint_matcher_fp_sharded_update_and_growth(mesh):
+    rules = mk_rules(200)
+    m = HintMatcher(rules, backend="jax-fp-sharded", mesh=mesh)
+    caps0 = dict(m._caps)
+    rules2 = [HintRule(host="swap.example.org")] + rules[1:]
+    m.set_rules(rules2)
+    assert m._caps == caps0  # same shapes: caps reused
+    assert m.match([Hint(host="swap.example.org")])[0] == 0
+    # outgrow -> CapsExceeded -> transparent rebuild
+    big = rules2 + [HintRule(host=f"g{i}.grown.example.net")
+                    for i in range(900)]
+    m.set_rules(big)
+    got = m.match([Hint(host="g123.grown.example.net"),
+                   Hint(host="x.g7.grown.example.net")])
+    assert got[0] == oracle.search(big, Hint(host="g123.grown.example.net"))
+    assert got[1] == oracle.search(big,
+                                   Hint(host="x.g7.grown.example.net"))
+
+
+def test_cidr_matcher_fp_sharded_routes_and_acl(mesh):
+    import random
+
+    from vproxy_tpu.rules.ir import AclRule, Proto
+
+    rnd = random.Random(99)
+    nets = []
+    for i in range(120):
+        ml = rnd.choice([8, 12, 16, 24, 32])
+        ip = bytes([10 + i % 5, rnd.randint(0, 255), rnd.randint(0, 255), 0])
+        raw = bytes(a & b for a, b in zip(ip, mask_bytes(ml)))
+        nets.append(Network(raw, mask_bytes(ml)))
+    rm = CidrMatcher(nets, backend="jax-fp-sharded", mesh=mesh)
+    addrs = [bytes([10 + rnd.randint(0, 6), rnd.randint(0, 255),
+                    rnd.randint(0, 255), rnd.randint(0, 255)])
+             for _ in range(64)]
+    got = rm.match(addrs)
+    for i, a in enumerate(addrs):
+        want = next((j for j, n in enumerate(nets) if n.contains_ip(a)), -1)
+        assert got[i] == want, (i, got[i], want)
+
+    acl = [AclRule(f"r{i}", nets[i], Proto.TCP, (i * 700) % 60000,
+                   (i * 700) % 60000 + 2000, i % 2 == 0)
+           for i in range(len(nets))]
+    am = CidrMatcher(nets, backend="jax-fp-sharded", acl=acl, mesh=mesh)
+    ports = [rnd.randint(1, 65535) for _ in addrs]
+    got = am.match(addrs, ports)
+    for i, a in enumerate(addrs):
+        assert got[i] == am.oracle_one(a, ports[i]), (i, got[i])
+
+
+def test_classify_service_drives_fp_sharded(mesh):
+    ClassifyService.reset()
+    svc = ClassifyService.get()
+    svc.mode = "device"
+    rules = mk_rules(250)
+    m = HintMatcher(rules, backend="jax-fp-sharded", mesh=mesh)
+    m.match(mk_queries(rules, 16))  # warm jit
+    n = 60
+    results = {}
+    done = threading.Event()
+    lock = threading.Lock()
+    hints = mk_queries(rules, n, seed=5)
+
+    def cb(i, idx):
+        with lock:
+            results[i] = idx
+            if len(results) == n:
+                done.set()
+
+    for i, h in enumerate(hints):
+        svc.submit_hint(m, h, lambda idx, _pl, i=i: cb(i, idx))
+    assert done.wait(60)
+    for i, h in enumerate(hints):
+        assert results[i] == oracle.search(rules, h), (i, h)
+    ClassifyService.reset()

@@ -148,4 +148,7 @@ def get_dns_servers(loop: SelectorEventLoop,
         send()
         loop.delay(timeout_ms, lambda: finish(None))
 
-    loop.run_on_loop(mk)
+    if not loop.run_on_loop(mk):
+        # loop is gone: the callback must still fire (per run_on_loop's
+        # cleanup contract), or waiters hang with no diagnostic
+        cb(set(), OSError("event loop is closed"))

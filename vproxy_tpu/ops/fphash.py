@@ -243,7 +243,11 @@ def _fill_rec(cap: int, e: int, m: int, slots: dict, buckets: dict,
 
 
 def compile_hint_fp(rules: Sequence[HintRule],
-                    caps: Optional[dict] = None) -> FpHintTable:
+                    caps: Optional[dict] = None,
+                    strict: bool = True) -> FpHintTable:
+    """strict=True (engine runtime updates): outgrowing supplied caps
+    raises CapsExceeded. strict=False (sharded cap unification): caps
+    grow silently toward the fixed point."""
     caps = dict(caps or {})
     n = len(rules)
     r_cap = caps.get("r_cap") or _pad_cap(n, 256)
@@ -353,8 +357,8 @@ def compile_hint_fp(rules: Sequence[HintRule],
                 "hE": hE, "hM": hM, "uE": uE, "uM": uM,
                 "whc": whc, "wuc": wuc, "lset": lset_cap,
                 "hw": hw, "uw": uw}
-    if caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
-                    for k in new_caps):
+    if strict and caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
+                               for k in new_caps):
         raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
     return FpHintTable(
         n=n, r_cap=r_cap, arrays=arrays,
@@ -614,7 +618,8 @@ def _prune_acl_members(items: list, acl) -> list:
 
 
 def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
-                    caps: Optional[dict] = None) -> FpCidrTable:
+                    caps: Optional[dict] = None,
+                    strict: bool = True) -> FpCidrTable:
     caps = dict(caps or {})
     n = len(networks)
     r_cap = caps.get("r_cap") or _pad_cap(n, 256)
@@ -728,8 +733,8 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
         arrays["mrows"] = mrows
     new_caps = {"r_cap": r_cap, "n4": n4, "n6": n6, "E": E, "ct": ct,
                 "Mk": Mk, "nm": nm}
-    if caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
-                    for k in new_caps):
+    if strict and caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
+                               for k in new_caps):
         raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
     return FpCidrTable(n=n, r_cap=r_cap, arrays=arrays, n4=n4,
                        caps=new_caps)
@@ -798,3 +803,41 @@ def classify_fp_all(hint_t: dict, route_t: dict, acl_t: dict,
     r_idx = cidr_fp_match(route_t, addr16, fam, None)
     a_idx = cidr_fp_match(acl_t, addr16, fam, port)
     return jnp.stack([h_idx, r_idx, a_idx], axis=1)
+
+
+# ----------------------------------------------------- mesh-sharded path
+#
+# Rule-axis sharding mirrors ops/hashmatch's ShardedHashTable: the rule
+# list is sliced, each slice compiled into its OWN fp table under ONE
+# unified caps dict (identical shapes), and the per-shard arrays stack
+# on a leading axis carrying the mesh's "rules" PartitionSpec. Each
+# device runs the UNCHANGED single-shard fp kernel on its slice inside
+# shard_map; winners reduce with the same pmax/pmin collectives.
+
+from .hashmatch import _compile_sharded, ShardedHashTable  # noqa: E402
+
+
+def compile_hint_fp_sharded(rules: Sequence[HintRule], n_shards: int,
+                            caps: Optional[dict] = None) -> ShardedHashTable:
+    return _compile_sharded(
+        rules, n_shards,
+        lambda s, off, caps: compile_hint_fp(s, caps=caps, strict=False),
+        caps)
+
+
+def compile_cidr_fp_sharded(networks: Sequence, n_shards: int,
+                            acl: Optional[Sequence[AclRule]] = None,
+                            caps: Optional[dict] = None) -> ShardedHashTable:
+    return _compile_sharded(
+        networks, n_shards,
+        lambda s, off, caps: compile_cidr_fp(
+            s, acl=None if acl is None else acl[off: off + len(s)],
+            caps=caps, strict=False), caps)
+
+
+def encode_hint_queries_fp_sharded(hints: Sequence,
+                                   stab: ShardedHashTable) -> dict:
+    """Per-shard probe encodings stacked on the leading shard axis
+    (salts and slot offsets are shard-local)."""
+    per = [encode_hint_queries_fp(hints, t) for t in stab.shards]
+    return {k: np.stack([p[k] for p in per]) for k in per[0]}

@@ -631,16 +631,19 @@ class CapsExceeded(Exception):
 
 def _compile_sharded(items: Sequence, n_shards: int, compile_one,
                      caps: Optional[dict]) -> ShardedHashTable:
-    """compile_one(slice, shard_idx, caps) -> per-shard table. When caps
-    is supplied (the runtime-update fast path), the result MUST fit:
-    growth raises CapsExceeded instead of silently changing shapes and
-    retracing the caller's jitted classify."""
+    """compile_one(slice, item_offset, caps) -> per-shard table; the
+    offset is the slice's start index in `items`, so positional side
+    tables (ACL windows) stay aligned with the slicing by construction.
+    When caps is supplied (the runtime-update fast path), the result
+    MUST fit: growth raises CapsExceeded instead of silently changing
+    shapes and retracing the caller's jitted classify."""
     reused = dict(caps) if caps else None
     per = max(1, -(-len(items) // n_shards))  # ceil; empty tail shards ok
     slices = [list(items[d * per: (d + 1) * per]) for d in range(n_shards)]
     caps = dict(caps or {})
     for _ in range(6):  # caps only grow; fixed point in a few rounds
-        tabs = [compile_one(s, d, caps) for d, s in enumerate(slices)]
+        tabs = [compile_one(s, d * per, caps)
+                for d, s in enumerate(slices)]
         merged = _unify_caps([t.caps for t in tabs])
         if all(t.caps == merged for t in tabs):
             if reused is not None and merged != reused:
@@ -659,18 +662,18 @@ def compile_hint_hash_sharded(rules: Sequence[HintRule], n_shards: int,
                               caps: Optional[dict] = None) -> ShardedHashTable:
     return _compile_sharded(
         rules, n_shards,
-        lambda s, d, caps: compile_hint_hash(s, caps=caps), caps)
+        lambda s, off, caps: compile_hint_hash(s, caps=caps), caps)
 
 
 def compile_cidr_hash_sharded(networks: Sequence, n_shards: int,
                               acl: Optional[Sequence[AclRule]] = None,
                               caps: Optional[dict] = None) -> ShardedHashTable:
-    per = max(1, -(-len(networks) // n_shards))
-    # each shard's ACL window follows its rule slice positionally
+    # each shard's ACL window follows its rule slice positionally (the
+    # offset comes FROM the slicer, so they cannot drift apart)
     return _compile_sharded(
         networks, n_shards,
-        lambda s, d, caps: compile_cidr_hash(
-            s, acl=None if acl is None else acl[d * per: d * per + len(s)],
+        lambda s, off, caps: compile_cidr_hash(
+            s, acl=None if acl is None else acl[off: off + len(s)],
             caps=caps), caps)
 
 

@@ -113,16 +113,19 @@ def _shard_map(body, mesh, in_specs, out_specs):
 
 
 def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
-                         query_keys_ndim: dict):
+                         query_keys_ndim: dict, kernel=None):
     """-> jitted fn(stacked_table, stacked_queries, shard_size) -> [B] i32
     global hint-rule index (-1 none) for the ENGINE's jax-sharded
-    backend. shard_size is a traced scalar, so rule-count changes within
+    backends. `kernel` is the per-shard matcher — hashmatch (cuckoo,
+    default) or fphash's hint_fp_match; both share the (idx, level)
+    contract. shard_size is a traced scalar, so rule-count changes within
     the same caps reuse the compiled program; caps (shape) changes just
     retrace. Winner = pmax(match level) then pmin(global index) among
     level winners — Upstream.java:187 semantics as an ICI reduction."""
     import jax.numpy as jnp
 
     from ..ops.hashmatch import hint_hash_match
+    hint_match = kernel or hint_hash_match
 
     BIG = 2 ** 30
 
@@ -130,7 +133,7 @@ def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
         sid = jax.lax.axis_index("rules").astype(jnp.int32)
         ht0 = {k: v[0] for k, v in ht.items()}
         hq0 = {k: v[0] for k, v in hq.items()}
-        hidx, hlvl = hint_hash_match(ht0, hq0)
+        hidx, hlvl = hint_match(ht0, hq0)
         lvl = jnp.where(hidx >= 0, hlvl, 0)
         best_lvl = jax.lax.pmax(lvl, "rules")
         gidx = jnp.where((lvl == best_lvl) & (hidx >= 0),
@@ -150,7 +153,7 @@ def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
 
 
 def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
-                         with_port: bool):
+                         with_port: bool, kernel=None):
     """-> jitted fn(stacked_table, a16, fam, [port,] shard_size) -> [B]
     i32 global first-match index (-1 none); first-match = one pmin over
     global indices (insert order is preserved across contiguous rule
@@ -158,6 +161,7 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
     import jax.numpy as jnp
 
     from ..ops.hashmatch import cidr_hash_match
+    cidr_match = kernel or cidr_hash_match
 
     BIG = 2 ** 30
 
@@ -165,7 +169,7 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
         def body(t, a16, fam, port, shard_size):
             sid = jax.lax.axis_index("rules").astype(jnp.int32)
             t0 = {k: v[0] for k, v in t.items()}
-            li = cidr_hash_match(t0, a16, fam, port)
+            li = cidr_match(t0, a16, fam, port)
             g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
                              "rules")
             return jnp.where(g < BIG, g, -1)
@@ -174,7 +178,7 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
         def body(t, a16, fam, shard_size):
             sid = jax.lax.axis_index("rules").astype(jnp.int32)
             t0 = {k: v[0] for k, v in t.items()}
-            li = cidr_hash_match(t0, a16, fam, None)
+            li = cidr_match(t0, a16, fam, None)
             g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
                              "rules")
             return jnp.where(g < BIG, g, -1)

@@ -28,6 +28,10 @@ the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
                 that outgrows the caps (ops.hashmatch.CapsExceeded)
                 transparently rebuilds tables — the jitted fn simply
                 retraces on the new shapes.
+* "jax-fp-sharded" — the packed fingerprint kernels over the same mesh
+                machinery: per-shard fp tables under one unified caps
+                dict, same pmax/pmin winner reduction. The multi-chip
+                form of the throughput path.
 
 Rule updates never retrace: tables are fixed-capacity (padded), and an
 update recompiles numpy arrays and re-uploads same-shape buffers (the
@@ -144,20 +148,28 @@ class HintMatcher:
                 self._tab = F.compile_hint_fp(self._rules)
             self._caps = self._tab.caps
             self._dev = _to_device(self._tab.arrays)
-        elif self.backend == "jax-sharded":
+        elif self.backend in ("jax-sharded", "jax-fp-sharded"):
             from ..parallel import mesh as M
             if self._mesh is None:
                 self._mesh = default_mesh()
             shards = self._mesh.shape["rules"]
+            if self.backend == "jax-fp-sharded":
+                from ..ops import fphash as F
+                compile_sharded = F.compile_hint_fp_sharded
+            else:
+                compile_sharded = H.compile_hint_hash_sharded
             try:
-                self._tab = H.compile_hint_hash_sharded(
-                    self._rules, shards, caps=self._caps)
+                self._tab = compile_sharded(self._rules, shards,
+                                            caps=self._caps)
             except H.CapsExceeded:
                 # update outgrew the reused shapes: transparent rebuild
                 # (the jitted fn retraces on the new shapes)
-                self._tab = H.compile_hint_hash_sharded(self._rules, shards)
+                self._tab = compile_sharded(self._rules, shards)
             self._caps = self._tab.shards[0].caps
             self._dev = M.shard_hash_table(self._tab, self._mesh)
+            # _fn is NOT reset: it closes over key ndims + kernel only,
+            # and jit re-specializes on shape changes by itself — the
+            # caps-reuse no-retrace contract depends on keeping it
         elif self.backend == "jax-dense":
             cap = self._dev["active"].shape[0] if self._dev is not None else None
             if cap is not None and len(self._rules) > cap:
@@ -224,17 +236,23 @@ class HintMatcher:
             q = F.encode_hint_queries_fp(hints, tab)
             idx, _ = F.hint_fp_jit(dev, q)
             return idx
-        if self.backend == "jax-sharded":
+        if self.backend in ("jax-sharded", "jax-fp-sharded"):
             from ..parallel import mesh as M
             n = len(hints)
             cap = pad_batch(n, self._mesh.shape["batch"])
             padded = list(hints) + [Hint()] * (cap - n)
-            q = H.encode_hint_queries_sharded(padded, tab)
+            if self.backend == "jax-fp-sharded":
+                from ..ops import fphash as F
+                q = F.encode_hint_queries_fp_sharded(padded, tab)
+                kernel = F.hint_fp_match
+            else:
+                q = H.encode_hint_queries_sharded(padded, tab)
+                kernel = None
             qd = M.shard_hint_queries_sharded(q, self._mesh)
             if self._fn is None:
                 self._fn = M.make_sharded_hint_fn(
                     self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
-                    {k: v.ndim for k, v in q.items()})
+                    {k: v.ndim for k, v in q.items()}, kernel=kernel)
             out = self._fn(dev, qd, np.int32(tab.shard_size))
             return np.asarray(out)[:n]
         q = T.encode_hints(hints)
@@ -285,20 +303,26 @@ class CidrMatcher:
                 tab = F.compile_cidr_fp(self._nets, acl=self._acl)
             self._caps = tab.caps
             self._dev = _to_device(tab.arrays)
-        elif self.backend == "jax-sharded":
+        elif self.backend in ("jax-sharded", "jax-fp-sharded"):
             from ..parallel import mesh as M
             if self._mesh is None:
                 self._mesh = default_mesh()
             shards = self._mesh.shape["rules"]
+            if self.backend == "jax-fp-sharded":
+                from ..ops import fphash as F
+                compile_sharded = F.compile_cidr_fp_sharded
+            else:
+                compile_sharded = H.compile_cidr_hash_sharded
             try:
-                self._tab = H.compile_cidr_hash_sharded(
+                self._tab = compile_sharded(
                     self._nets, shards, acl=self._acl, caps=self._caps)
             except H.CapsExceeded:
                 # update outgrew the reused shapes: transparent rebuild
-                self._tab = H.compile_cidr_hash_sharded(
-                    self._nets, shards, acl=self._acl)
+                self._tab = compile_sharded(self._nets, shards,
+                                            acl=self._acl)
             self._caps = self._tab.shards[0].caps
             self._dev = M.shard_hash_table(self._tab, self._mesh)
+            # _fns kept: see HintMatcher._recompile
         elif self.backend == "jax-dense":
             cap = self._dev["allow"].shape[0] if self._dev is not None else None
             if cap is not None and len(self._nets) > cap:
@@ -371,7 +395,7 @@ class CidrMatcher:
         if self.backend == "jax-fp":
             from ..ops import fphash as F
             return F.cidr_fp_jit(dev, a16, fam, p)
-        if self.backend == "jax-sharded":
+        if self.backend in ("jax-sharded", "jax-fp-sharded"):
             return self._dispatch_sharded(snap, a16, fam, p)
         return cidr_match_jit(dev, a16, fam, p)
 
@@ -391,9 +415,13 @@ class CidrMatcher:
         with_port = p is not None
         fn = self._fns.get(with_port)
         if fn is None:
+            kernel = None
+            if self.backend == "jax-fp-sharded":
+                from ..ops import fphash as F
+                kernel = F.cidr_fp_match
             fn = self._fns[with_port] = M.make_sharded_cidr_fn(
                 self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
-                with_port)
+                with_port, kernel=kernel)
         size = np.int32(tab.shard_size)
         out = fn(dev, a16d, famd, pd, size) if with_port \
             else fn(dev, a16d, famd, size)
