@@ -120,8 +120,9 @@ def test_http_connect_front(stack):
     while b"\r\n\r\n" not in head:
         head += c.recv(4096)
     assert b" 200 " in head
+    # early tunnel bytes (the IdServer id) may coalesce with the reply
+    head, _, data = head.partition(b"\r\n\r\n")
     c.sendall(b"yo")
-    data = b""
     try:
         while len(data) < 3:
             d = c.recv(4096)
