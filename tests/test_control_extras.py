@@ -106,10 +106,10 @@ def test_resp_and_http_controller_resources(app):
     assert app.resp_controllers == {} and app.http_controllers == {}
 
 
-def test_docker_plugin_descope(app):
+def test_docker_plugin_requires_path(app):
     assert Command.execute(
         app, "list docker-network-plugin-controller") == []
-    with pytest.raises(CmdError, match="descoped"):
+    with pytest.raises(CmdError, match="path"):
         Command.execute(app, "add docker-network-plugin-controller d0")
 
 

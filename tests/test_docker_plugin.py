@@ -1,0 +1,220 @@
+"""Docker libnetwork network plugin: HTTP over a real unix socket driving
+the vswitch with real tap devices (DockerNetworkPluginController.java +
+DockerNetworkDriverImpl.java behavior)."""
+import json
+import os
+import socket
+
+import pytest
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import Command
+from vproxy_tpu.control.docker import (ANNO_ENDPOINT_ID, ANNO_ENDPOINT_IPV4,
+                                       ANNO_NETWORK_ID, GATEWAY_MAC,
+                                       SWITCH_NAME)
+from vproxy_tpu.control import persist
+from vproxy_tpu.vswitch.iface import tap_supported
+
+NET_ID = "cafebabe0001cafebabe0001cafebabe0001"
+EP_ID = "deadbeef0002deadbeef0002deadbeef0002"
+
+needs_tap = pytest.mark.skipif(not tap_supported(),
+                               reason="no /dev/net/tun access")
+
+
+@pytest.fixture
+def app(tmp_path, monkeypatch):
+    monkeypatch.setenv("VPROXY_TPU_DOCKER_SCRIPTS", str(tmp_path / "scripts"))
+    monkeypatch.setenv("VPROXY_TPU_DOCKER_SWITCH_ADDR", "127.0.0.1:0")
+    a = Application.create(workers=1)
+    yield a
+    a.close()
+
+
+@pytest.fixture
+def plugin(app, tmp_path):
+    path = str(tmp_path / "vproxy.sock")
+    assert Command.execute(
+        app, f"add docker-network-plugin-controller dk0 path {path}") == "OK"
+    return path
+
+
+def uds_post(path: str, route: str, body: dict) -> dict:
+    payload = json.dumps(body).encode()
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.settimeout(5)
+    c.connect(path)
+    c.sendall(b"POST " + route.encode() + b" HTTP/1.1\r\n"
+              b"host: plugin\r\ncontent-type: application/json\r\n"
+              b"content-length: " + str(len(payload)).encode() +
+              b"\r\nconnection: close\r\n\r\n" + payload)
+    buf = b""
+    while True:
+        d = c.recv(65536)
+        if not d:
+            break
+        buf += d
+    c.close()
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    assert head.split(b" ", 2)[1] == b"200", head
+    return json.loads(rest)
+
+
+def mk_network(path, *, v6=False):
+    body = {"NetworkID": NET_ID,
+            "IPv4Data": [{"AddressSpace": "", "Pool": "172.28.0.0/16",
+                          "Gateway": "172.28.0.1/16"}],
+            "IPv6Data": []}
+    if v6:
+        body["IPv6Data"] = [{"AddressSpace": "", "Pool": "fd00:2800::/64",
+                             "Gateway": "fd00:2800::1/64"}]
+    return uds_post(path, "/NetworkDriver.CreateNetwork", body)
+
+
+def test_activate_and_capabilities(plugin):
+    assert uds_post(plugin, "/Plugin.Activate", {}) == {
+        "Implements": ["NetworkDriver"]}
+    caps = uds_post(plugin, "/NetworkDriver.GetCapabilities", {})
+    assert caps["Scope"] == "local"
+
+
+def test_create_network_builds_vpc(app, plugin):
+    assert mk_network(plugin) == {}
+    sw = app.switches[SWITCH_NAME]
+    assert len(sw.networks) == 1
+    net = next(iter(sw.networks.values()))
+    assert net.annotations[ANNO_NETWORK_ID] == NET_ID
+    assert str(net.v4net) == "172.28.0.0/16"
+    # gateway synthetic ip under the reserved gateway mac
+    gws = [ip for ip, mac in net.ips.ips().items() if mac == GATEWAY_MAC]
+    assert [socket.inet_ntoa(ip) for ip in gws if len(ip) == 4] == ["172.28.0.1"]
+    # delete tears it down
+    assert uds_post(plugin, "/NetworkDriver.DeleteNetwork",
+                    {"NetworkID": NET_ID}) == {}
+    assert not sw.networks
+
+
+def test_create_network_validation(plugin):
+    r = uds_post(plugin, "/NetworkDriver.CreateNetwork",
+                 {"NetworkID": "x", "IPv4Data": [], "IPv6Data": []})
+    assert "no ipv4" in r["Err"]
+    r = uds_post(plugin, "/NetworkDriver.CreateNetwork",
+                 {"NetworkID": "x",
+                  "IPv4Data": [{"Pool": "10.0.0.0/24", "Gateway": "10.9.9.9/24"}],
+                  "IPv6Data": []})
+    assert "does not contain the gateway" in r["Err"]
+    r = uds_post(plugin, "/NetworkDriver.CreateNetwork",
+                 {"NetworkID": "x",
+                  "IPv4Data": [{"Pool": "10.0.0.0/24", "Gateway": "10.0.0.1/16"}],
+                  "IPv6Data": []})
+    assert "mask" in r["Err"]
+    r = uds_post(plugin, "/NetworkDriver.DeleteNetwork", {"NetworkID": "nope"})
+    assert "not found" in r["Err"]
+
+
+@needs_tap
+def test_endpoint_lifecycle(app, plugin, tmp_path):
+    mk_network(plugin, v6=True)
+    r = uds_post(plugin, "/NetworkDriver.CreateEndpoint",
+                 {"NetworkID": NET_ID, "EndpointID": EP_ID,
+                  "Interface": {"Address": "172.28.0.5/16",
+                                "AddressIPv6": "fd00:2800::5/64",
+                                "MacAddress": "02:42:ac:1c:00:05"}})
+    assert r == {}
+    sw = app.switches[SWITCH_NAME]
+    taps = [i for i in sw.list_ifaces() if i.name.startswith("tap:")]
+    assert len(taps) == 1
+    tap = taps[0]
+    assert tap.dev == "tap" + EP_ID[:12]
+    assert tap.annotations[ANNO_ENDPOINT_ID] == EP_ID
+    assert tap.annotations[ANNO_ENDPOINT_IPV4] == "172.28.0.5/16"
+    script = tmp_path / "scripts" / EP_ID
+    assert script.exists() and script.read_text() == ""
+    assert os.access(script, os.X_OK)
+
+    # oper info is an empty Value
+    assert uds_post(plugin, "/NetworkDriver.EndpointOperInfo",
+                    {"NetworkID": NET_ID, "EndpointID": EP_ID}) == {"Value": {}}
+
+    # join hands docker the iface name + gateways and writes the script
+    r = uds_post(plugin, "/NetworkDriver.Join",
+                 {"NetworkID": NET_ID, "EndpointID": EP_ID,
+                  "SandboxKey": "/var/run/docker/netns/abcd1234"})
+    assert r["InterfaceName"] == {"SrcName": tap.dev, "DstPrefix": "eth"}
+    assert r["Gateway"] == "172.28.0.1"
+    assert r["GatewayIPv6"] == "fd00:2800::1"
+    body = script.read_text()
+    assert "ip link set $DEV netns abcd1234" in body
+    assert "ip address add 172.28.0.5/16 dev $DEV" in body
+    assert "default via 172.28.0.1" in body
+    assert "-6 route add default via fd00:2800::1" in body
+
+    # leave truncates; delete removes tap + script
+    assert uds_post(plugin, "/NetworkDriver.Leave",
+                    {"NetworkID": NET_ID, "EndpointID": EP_ID}) == {}
+    assert script.read_text() == ""
+    assert uds_post(plugin, "/NetworkDriver.DeleteEndpoint",
+                    {"NetworkID": NET_ID, "EndpointID": EP_ID}) == {}
+    assert not [i for i in sw.list_ifaces() if i.name.startswith("tap:")]
+    assert not script.exists()
+
+
+@needs_tap
+def test_endpoint_requires_ipv4_and_network(app, plugin):
+    mk_network(plugin)
+    r = uds_post(plugin, "/NetworkDriver.CreateEndpoint",
+                 {"NetworkID": NET_ID, "EndpointID": EP_ID})
+    assert "auto ip allocation" in r["Err"]
+    r = uds_post(plugin, "/NetworkDriver.CreateEndpoint",
+                 {"NetworkID": NET_ID, "EndpointID": EP_ID,
+                  "Interface": {"Address": "172.28.0.5/16",
+                                "AddressIPv6": "fd00::5/64"}})
+    assert "does not support ipv6" in r["Err"]
+    r = uds_post(plugin, "/NetworkDriver.Join",
+                 {"NetworkID": NET_ID, "EndpointID": "missing",
+                  "SandboxKey": "/x/y"})
+    assert "not found" in r["Err"]
+
+
+def test_command_grammar_and_persist(app, plugin, tmp_path):
+    assert Command.execute(
+        app, "list docker-network-plugin-controller") == ["dk0"]
+    detail = Command.execute(
+        app, "list-detail docker-network-plugin-controller")
+    assert detail == [f"dk0 -> path {plugin}"]
+    cfg = persist.current_config(app)
+    assert f"add docker-network-plugin-controller dk0 path {plugin}" in cfg
+    assert Command.execute(
+        app, "remove docker-network-plugin-controller dk0") == "OK"
+    assert not os.path.exists(plugin)
+
+
+@needs_tap
+def test_persist_replays_docker_state(app, plugin, tmp_path):
+    """Checkpoint/resume: the annotated vpc + tap + controller replay
+    through the command engine (Shutdown.currentConfig parity)."""
+    mk_network(plugin)
+    uds_post(plugin, "/NetworkDriver.CreateEndpoint",
+             {"NetworkID": NET_ID, "EndpointID": EP_ID,
+              "Interface": {"Address": "172.28.0.5/16"}})
+    cfg = persist.current_config(app)
+    assert ANNO_NETWORK_ID in cfg          # vpc annotations survive
+    assert f"add tap tap{EP_ID[:12]} to switch {SWITCH_NAME}" in cfg
+    p = tmp_path / "saved.cfg"
+    p.write_text(cfg)
+
+    app.close()
+    app2 = Application.create(workers=1)
+    try:
+        persist.load(app2, str(p))
+        sw = app2.switches[SWITCH_NAME]
+        net = next(iter(sw.networks.values()))
+        assert net.annotations[ANNO_NETWORK_ID] == NET_ID
+        taps = [i for i in sw.list_ifaces() if i.name.startswith("tap:")]
+        assert [t.annotations.get(ANNO_ENDPOINT_ID) for t in taps] == [EP_ID]
+        # the reserved gateway mac must survive the replay (Join depends
+        # on finding the gateway by mac)
+        gws = [ip for ip, mac in net.ips.ips().items() if mac == GATEWAY_MAC]
+        assert [socket.inet_ntoa(ip) for ip in gws] == ["172.28.0.1"]
+    finally:
+        app2.close()

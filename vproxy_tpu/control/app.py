@@ -41,6 +41,7 @@ class Application:
         self.switches: dict[str, object] = {}
         self.resp_controllers: dict[str, object] = {}
         self.http_controllers: dict[str, object] = {}
+        self.docker_controllers: dict[str, object] = {}
         # (switch alias, vni) -> {"ip:port": VpcProxy}
         self.vpc_proxies: dict[tuple, dict] = {}
         self._resolver = None  # lazy "(default)" resolver
@@ -95,6 +96,8 @@ class Application:
         return cls._instance
 
     def close(self) -> None:
+        for ctl in self.docker_controllers.values():
+            ctl.stop()  # unlinks the uds socket file
         for lb in list(self.tcp_lbs.values()) + list(self.socks5_servers.values()):
             lb.stop()
         for d in self.dns_servers.values():

@@ -90,6 +90,7 @@ PARAM_KEYS = {
     "via": "via", "mac": "mac",
     "mac-table-timeout": "mac-table-timeout",
     "arp-table-timeout": "arp-table-timeout",
+    "path": "path", "post-script": "post-script",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -218,6 +219,17 @@ def _anno_to_rule(anno_json: str) -> HintRule:
         raise CmdError(f"annotations must be json: {e}")
     return HintRule(host=d.get(ANNO_HOST), port=int(d.get(ANNO_PORT, 0)),
                     uri=d.get(ANNO_URI))
+
+
+def _anno_dict(raw: str) -> dict:
+    """Generic annotations param (json object) for vpc/tap resources."""
+    try:
+        d = json.loads(raw)
+    except json.JSONDecodeError:
+        raise CmdError(f"bad annotations json {raw!r}")
+    if not isinstance(d, dict):
+        raise CmdError("annotations must be a json object")
+    return d
 
 
 def _rule_to_anno(rule: HintRule) -> str:
@@ -698,8 +710,10 @@ def _h_vpc(app: Application, c: Command):
         if "v4network" not in c.params:
             raise CmdError("vpc requires v4network")
         v6 = Network.parse(c.params["v6network"]) if "v6network" in c.params else None
+        anno = _anno_dict(c.params["annotations"]) if "annotations" in c.params else None
         try:
-            sw.add_network(vni, Network.parse(c.params["v4network"]), v6)
+            sw.add_network(vni, Network.parse(c.params["v4network"]), v6,
+                           annotations=anno)
         except ValueError as e:
             raise CmdError(str(e))
         return "OK"
@@ -708,6 +722,8 @@ def _h_vpc(app: Application, c: Command):
             return [str(v) for v in sw.networks]
         return [f"{n.vni} -> v4network {n.v4net}"
                 + (f" v6network {n.v6net}" if n.v6net else "")
+                + (f" annotations {json.dumps(n.annotations, separators=(',', ':'))}"
+                   if n.annotations else "")
                 for n in sw.networks.values()]
     if c.action in ("remove", "force-remove"):
         try:
@@ -837,8 +853,11 @@ def _h_tap(app: Application, c: Command):
     if c.action == "add":
         if "vni" not in c.params:
             raise CmdError("tap requires `vni <n>`")
+        anno = _anno_dict(c.params["annotations"]) if "annotations" in c.params else None
         try:
-            iface = sw.add_tap(c.alias, int(c.params["vni"]))
+            iface = sw.add_tap(c.alias, int(c.params["vni"]),
+                               post_script=c.params.get("post-script"),
+                               annotations=anno)
         except OSError as e:
             raise CmdError(str(e))
         return iface.dev
@@ -855,11 +874,13 @@ def _h_tap(app: Application, c: Command):
 
 def _h_ip(app: Application, c: Command):
     from ..vswitch.switch import synthetic_mac
-    from ..vswitch.packets import mac_str
+    from ..vswitch.packets import mac_str, parse_mac
     sw, net = _ctx_vpc(app, c)
     if c.action == "add":
         ip = _parse_ip_str(c.alias)
-        net.ips.add(ip, synthetic_mac(net.vni, ip))
+        mac = (parse_mac(c.params["mac"]) if "mac" in c.params
+               else synthetic_mac(net.vni, ip))
+        net.ips.add(ip, mac)
         return "OK"
     if c.action in ("list", "list-detail"):
         return [f"{format_ip(ip)} -> mac {mac_str(mac)}"
@@ -1035,13 +1056,35 @@ def _h_httpc(app: Application, c: Command):
 
 
 def _h_docker(app: Application, c: Command):
-    """Recognized for grammar parity; the docker libnetwork plugin host
-    (unix-socket HTTP driver, DockerNetworkDriverImpl.java:421) is
-    explicitly descoped in this build — SURVEY §2.7 analog."""
-    if c.action in ("list", "list-detail"):
-        return []
-    raise CmdError("docker-network-plugin-controller is descoped in this "
-                   "build (no docker libnetwork plugin host)")
+    """Docker libnetwork plugin host: unix-socket HTTP driver bridging
+    docker networks onto the vswitch (DockerNetworkPluginController.java)."""
+    from .docker import DockerNetworkPluginController
+    if c.action == "add":
+        if c.alias in app.docker_controllers:
+            raise CmdError(f"docker-network-plugin-controller {c.alias} "
+                           "already exists")
+        if "path" not in c.params:
+            raise CmdError("docker-network-plugin-controller requires "
+                           "`path <uds-path>`")
+        try:
+            ctl = DockerNetworkPluginController(app, c.alias, c.params["path"])
+        except OSError as e:
+            raise CmdError(f"listen on {c.params['path']} failed: {e}")
+        app.docker_controllers[c.alias] = ctl
+        return "OK"
+    if c.action == "list":
+        return list(app.docker_controllers.keys())
+    if c.action == "list-detail":
+        return [f"{a} -> path {ctl.path}"
+                for a, ctl in app.docker_controllers.items()]
+    if c.action in ("remove", "force-remove"):
+        ctl = _need(app.docker_controllers, c.alias,
+                    "docker-network-plugin-controller")
+        ctl.stop()
+        del app.docker_controllers[c.alias]
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for "
+                   "docker-network-plugin-controller")
 
 
 _HANDLERS = {

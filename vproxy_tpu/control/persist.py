@@ -7,6 +7,7 @@ engine (:761). Auto-save runs hourly on the control loop (Main.java:371).
 """
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -90,12 +91,21 @@ def current_config(app: Application) -> str:
             f"arp-table-timeout {sw.arp_table_timeout_ms}{secg_part}")
         for net in sw.networks.values():
             v6 = f" v6network {net.v6net}" if net.v6net else ""
+            anno = (" annotations " + json.dumps(net.annotations,
+                                                 separators=(",", ":"))
+                    if net.annotations else "")
             lines.append(f"add vpc {net.vni} to switch {sw.alias} "
-                         f"v4network {net.v4net}{v6}")
+                         f"v4network {net.v4net}{v6}{anno}")
             from ..utils.ip import format_ip
-            for ip in net.ips.ips():
+            from ..vswitch.packets import mac_str
+            from ..vswitch.switch import synthetic_mac
+            for ip, mac in net.ips.ips().items():
+                # non-default macs (e.g. the docker gateway mac) must
+                # survive the replay or post-reload Joins break
+                mac_part = ("" if mac == synthetic_mac(net.vni, ip)
+                            else f" mac {mac_str(mac)}")
                 lines.append(f"add ip {format_ip(ip)} to vpc {net.vni} "
-                             f"in switch {sw.alias}")
+                             f"in switch {sw.alias}{mac_part}")
             for r in net.routes.rules:
                 tgt = f"vni {r.to_vni}" if r.to_vni else \
                     f"via {format_ip(r.via_ip)}"
@@ -109,6 +119,17 @@ def current_config(app: Application) -> str:
                 lines.append(
                     f"add switch {iface.alias} to switch {sw.alias} "
                     f"address {iface.remote[0]}:{iface.remote[1]}")
+            elif iface.name.startswith("tap:"):
+                ps = (f" post-script {iface.post_script}"
+                      if iface.post_script else "")
+                anno = (" annotations " + json.dumps(
+                    iface.annotations, separators=(",", ":"))
+                    if iface.annotations else "")
+                lines.append(f"add tap {iface.dev} to switch {sw.alias} "
+                             f"vni {iface.local_side_vni}{ps}{anno}")
+    for a, ctl in app.docker_controllers.items():
+        lines.append(f"add docker-network-plugin-controller {a} "
+                     f"path {ctl.path}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

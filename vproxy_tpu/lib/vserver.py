@@ -149,7 +149,18 @@ class HttpServer:
         self.loop.call_sync(mk)
         return self
 
-    def close(self) -> None:
+    def listen_unix(self, path: str) -> "HttpServer":
+        """Serve over a unix-domain socket (used by the docker
+        libnetwork plugin — DockerNetworkPluginController.java:56)."""
+        def mk() -> None:
+            self._srv = ServerSock.unix(self.loop, path, self._accept)
+        self.loop.call_sync(mk)
+        return self
+
+    def close(self, sync: bool = False) -> None:
+        """sync=True blocks until the listener is closed (and a unix
+        socket path unlinked) — callers reporting completion to an
+        operator need the fd gone, not merely scheduled to go."""
         if self._srv is not None:
             srv, self._srv = self._srv, None
 
@@ -158,7 +169,10 @@ class HttpServer:
                 for c in list(self._conns):
                     c.close_graceful()
                 self._conns.clear()
-            self.loop.run_on_loop(shut)
+            if sync:
+                self.loop.call_sync(shut)
+            else:
+                self.loop.run_on_loop(shut)
 
     # ---------------------------------------------------------- internals
 

@@ -85,10 +85,56 @@ int vtl_accept(int lfd, char* ipbuf, int ipbuflen, int* port) {
     auto* a = (sockaddr_in*)&ss;
     inet_ntop(AF_INET, &a->sin_addr, ipbuf, ipbuflen);
     *port = ntohs(a->sin_port);
-  } else {
+  } else if (ss.ss_family == AF_INET6) {
     auto* a = (sockaddr_in6*)&ss;
     inet_ntop(AF_INET6, &a->sin6_addr, ipbuf, ipbuflen);
     *port = ntohs(a->sin6_port);
+  } else {  // AF_UNIX peer: no address to report
+    if (ipbuflen > 0) ipbuf[0] = 0;
+    *port = 0;
+  }
+  return fd;
+}
+
+// unix-domain stream listener (UDSPath analog). Removes a stale socket
+// file first if nothing is accepting on it.
+int vtl_unix_listen(const char* path, int backlog) {
+  sockaddr_un sa;
+  if (strlen(path) >= sizeof(sa.sun_path)) return -ENAMETOOLONG;
+  int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (probe >= 0) {
+    memset(&sa, 0, sizeof(sa));
+    sa.sun_family = AF_UNIX;
+    strcpy(sa.sun_path, path);
+    if (connect(probe, (sockaddr*)&sa, sizeof(sa)) < 0 &&
+        (errno == ECONNREFUSED || errno == ENOENT)) {
+      unlink(path);  // dead leftover from a previous process
+    }
+    close(probe);
+  }
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  memset(&sa, 0, sizeof(sa));
+  sa.sun_family = AF_UNIX;
+  strcpy(sa.sun_path, path);
+  int r;
+  if (bind(fd, (sockaddr*)&sa, sizeof(sa)) < 0) { r = -errno; close(fd); return r; }
+  if (listen(fd, backlog) < 0) { r = -errno; close(fd); return r; }
+  return fd;
+}
+
+int vtl_unix_connect(const char* path) {
+  sockaddr_un sa;
+  if (strlen(path) >= sizeof(sa.sun_path)) return -ENAMETOOLONG;
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  memset(&sa, 0, sizeof(sa));
+  sa.sun_family = AF_UNIX;
+  strcpy(sa.sun_path, path);
+  if (connect(fd, (sockaddr*)&sa, sizeof(sa)) < 0 && errno != EINPROGRESS) {
+    int r = -errno;
+    close(fd);
+    return r;
   }
   return fd;
 }
@@ -191,10 +237,15 @@ int vtl_sock_name(int fd, int peer, char* ipbuf, int ipbuflen, int* port) {
     auto* a = (sockaddr_in*)&ss;
     inet_ntop(AF_INET, &a->sin_addr, ipbuf, ipbuflen);
     *port = ntohs(a->sin_port);
-  } else {
+  } else if (ss.ss_family == AF_INET6) {
     auto* a = (sockaddr_in6*)&ss;
     inet_ntop(AF_INET6, &a->sin6_addr, ipbuf, ipbuflen);
     *port = ntohs(a->sin6_port);
+  } else {  // AF_UNIX: report the bound path (empty for the peer side)
+    auto* a = (sockaddr_un*)&ss;
+    strncpy(ipbuf, len > sizeof(sa_family_t) ? a->sun_path : "", ipbuflen - 1);
+    ipbuf[ipbuflen - 1] = 0;
+    *port = 0;
   }
   return 0;
 }

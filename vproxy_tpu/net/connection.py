@@ -62,6 +62,11 @@ class Connection:
         fd = vtl.tcp_connect(ip, port)
         return cls(loop, fd, (ip, port), connecting=True)
 
+    @classmethod
+    def connect_unix(cls, loop: SelectorEventLoop, path: str) -> "Connection":
+        fd = vtl.unix_connect(path)
+        return cls(loop, fd, (path, 0), connecting=True)
+
     def set_handler(self, h: Handler) -> None:
         self.handler = h
 
@@ -185,15 +190,28 @@ class Connection:
 class ServerSock:
     def __init__(self, loop: SelectorEventLoop, ip: str, port: int,
                  on_accept: Callable[[int, str, int], None],
-                 backlog: int = 512, reuseport: bool = False):
+                 backlog: int = 512, reuseport: bool = False,
+                 _fd: Optional[int] = None):
         self.loop = loop
         self.ip, self.port = ip, port
-        self.fd = vtl.tcp_listen(ip, port, backlog, reuseport, ":" in ip)
+        self.fd = vtl.tcp_listen(ip, port, backlog, reuseport,
+                                 ":" in ip) if _fd is None else _fd
         self.on_accept = on_accept
         self.closed = False
         loop.add(self.fd, vtl.EV_READ, self._on_event)
-        if port == 0:
+        if port == 0 and _fd is None:
             _, self.port = vtl.sock_name(self.fd)
+
+    @classmethod
+    def unix(cls, loop: SelectorEventLoop, path: str,
+             on_accept: Callable[[int, str, int], None],
+             backlog: int = 512) -> "ServerSock":
+        """Listen on a unix-domain socket path (vfd UDSPath analog);
+        accepted peers are reported with ip="" port=0."""
+        fd = vtl.unix_listen(path, backlog)
+        srv = cls(loop, path, 0, on_accept, backlog, _fd=fd)
+        srv.unix_path = path
+        return srv
 
     def _on_event(self, fd: int, ev: int) -> None:
         while not self.closed:
@@ -203,9 +221,17 @@ class ServerSock:
             cfd, ip, port = r
             self.on_accept(cfd, ip, port)
 
+    unix_path: Optional[str] = None
+
     def close(self) -> None:
         if self.closed:
             return
         self.closed = True
         self.loop.remove(self.fd)
         vtl.close(self.fd)
+        if self.unix_path is not None:
+            try:
+                import os
+                os.unlink(self.unix_path)
+            except OSError:
+                pass

@@ -46,6 +46,8 @@ def _load() -> ctypes.CDLL:
     lib.vtl_tcp_listen.argtypes = [ctypes.c_char_p, c, c, c, c]
     lib.vtl_accept.argtypes = [c, ctypes.c_char_p, c, ctypes.POINTER(c)]
     lib.vtl_tcp_connect.argtypes = [ctypes.c_char_p, c, c]
+    lib.vtl_unix_listen.argtypes = [ctypes.c_char_p, c]
+    lib.vtl_unix_connect.argtypes = [ctypes.c_char_p]
     lib.vtl_finish_connect.argtypes = [c]
     lib.vtl_udp_bind.argtypes = [ctypes.c_char_p, c, c, c]
     lib.vtl_udp_socket.argtypes = [c]
@@ -97,6 +99,16 @@ def tcp_connect(ip: str, port: int) -> int:
 
 def finish_connect(fd: int) -> int:
     return LIB.vtl_finish_connect(fd)  # 0 ok else -errno
+
+
+def unix_listen(path: str, backlog: int = 512) -> int:
+    """Unix-domain stream listener (UDSPath analog); clears stale
+    socket files nothing is accepting on."""
+    return check(LIB.vtl_unix_listen(path.encode(), backlog))
+
+
+def unix_connect(path: str) -> int:
+    return check(LIB.vtl_unix_connect(path.encode()))
 
 
 def udp_bind(ip: str, port: int, reuseport: bool = False) -> int:

@@ -122,9 +122,13 @@ class TapIface(Iface):
     kernel enter the switch tagged with `vni` (TapIface.java +
     vfd_posix createTapFD :766). Requires /dev/net/tun access (root)."""
 
-    def __init__(self, pattern: str, vni: int, loop, on_frame):
+    post_script: Optional[str] = None
+
+    def __init__(self, pattern: str, vni: int, loop, on_frame,
+                 annotations: Optional[dict] = None):
         """on_frame(tap_iface, Ethernet) delivers inbound frames."""
         self.local_side_vni = vni
+        self.annotations: dict = annotations or {}
         self.fd = os.open("/dev/net/tun", os.O_RDWR | os.O_NONBLOCK)
         ifr = struct.pack("16sH", pattern.encode(), IFF_TAP | IFF_NO_PI)
         out = fcntl.ioctl(self.fd, TUNSETIFF, ifr)

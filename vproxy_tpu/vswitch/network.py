@@ -129,10 +129,14 @@ class VpcNetwork:
                  v6net: Optional[Network] = None,
                  mac_timeout_ms: int = MAC_TABLE_TIMEOUT,
                  arp_timeout_ms: int = ARP_TABLE_TIMEOUT,
-                 matcher_backend: Optional[str] = None):
+                 matcher_backend: Optional[str] = None,
+                 annotations: Optional[dict] = None):
         self.vni = vni
         self.v4net = v4net
         self.v6net = v6net
+        # free-form key/value tags (Table.java annotations; the docker
+        # network driver stores its networkId mapping here)
+        self.annotations: dict = annotations or {}
         self.macs = MacTable(mac_timeout_ms)
         self.arps = ArpTable(arp_timeout_ms)
         self.ips = SyntheticIpHolder()

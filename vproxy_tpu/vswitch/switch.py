@@ -166,11 +166,13 @@ class Switch:
     # ---------------------------------------------------------- resources
 
     def add_network(self, vni: int, v4net: Network,
-                    v6net: Optional[Network] = None) -> VpcNetwork:
+                    v6net: Optional[Network] = None,
+                    annotations: Optional[dict] = None) -> VpcNetwork:
         if vni in self.networks:
             raise ValueError(f"vpc {vni} already exists")
         net = VpcNetwork(vni, v4net, v6net, self.mac_table_timeout_ms,
-                         self.arp_table_timeout_ms, self.matcher_backend)
+                         self.arp_table_timeout_ms, self.matcher_backend,
+                         annotations=annotations)
         self.networks[vni] = net
         return net
 
@@ -208,10 +210,35 @@ class Switch:
         iface.attach(self)
         return iface
 
-    def add_tap(self, pattern: str, vni: int) -> TapIface:
+    def add_tap(self, pattern: str, vni: int,
+                post_script: Optional[str] = None,
+                annotations: Optional[dict] = None) -> TapIface:
+        """post_script: executable run after the device exists with DEV
+        set to the tap name (Switch.addTap's post-script hook — the
+        docker driver uses it to move the tap into a container netns
+        after a restart)."""
         if not tap_supported():
             raise OSError("tap devices not available (/dev/net/tun)")
-        iface = TapIface(pattern, vni, self.loop, self._tap_frame)
+        iface = TapIface(pattern, vni, self.loop, self._tap_frame,
+                         annotations=annotations)
+        iface.post_script = post_script
+        if post_script:
+            import os
+            import subprocess
+            if os.path.exists(post_script):
+                try:
+                    r = subprocess.run(["/bin/bash", post_script],
+                                       env={**os.environ, "DEV": iface.dev},
+                                       capture_output=True, timeout=10)
+                except subprocess.TimeoutExpired:
+                    iface.close()
+                    raise OSError(f"post script {post_script} timed out "
+                                  "(10s); tap removed")
+                if r.returncode != 0:
+                    iface.close()
+                    raise OSError(
+                        f"post script {post_script} failed "
+                        f"({r.returncode}): {r.stderr.decode()[:200]}")
         self._register(("tap", iface.dev), iface, permanent=True)
         return iface
 
