@@ -176,6 +176,40 @@ def test_endpoint_requires_ipv4_and_network(app, plugin):
     assert "not found" in r["Err"]
 
 
+def test_connect_unix_client(app, plugin):
+    """Our own client stack reaches the plugin socket:
+    Connection.connect_unix end-to-end against the UDS listener."""
+    import threading
+
+    from vproxy_tpu.net.connection import Connection, Handler
+
+    got = []
+    done = threading.Event()
+
+    class H(Handler):
+        def on_connected(self, conn):
+            conn.write(b"POST /Plugin.Activate HTTP/1.1\r\nhost: d\r\n"
+                       b"content-length: 0\r\nconnection: close\r\n\r\n")
+
+        def on_data(self, conn, data):
+            got.append(data)
+            if b"NetworkDriver" in b"".join(got):
+                done.set()
+
+        def on_eof(self, conn):
+            done.set()
+            conn.close()
+
+    lp = app.control_loop
+
+    def mk():
+        Connection.connect_unix(lp, plugin).set_handler(H())
+    lp.run_on_loop(mk)
+    assert done.wait(5)
+    body = b"".join(got)
+    assert b"200" in body and b"NetworkDriver" in body
+
+
 def test_command_grammar_and_persist(app, plugin, tmp_path):
     assert Command.execute(
         app, "list docker-network-plugin-controller") == ["dk0"]

@@ -786,14 +786,13 @@ def _h_route(app: Application, c: Command):
 
 
 def _h_arp(app: Application, c: Command):
-    from ..vswitch.packets import parse_mac
     sw, net = _ctx_vpc(app, c)
     if c.action == "add":
         # alias is the mac; `ip` given via address param? use network-less ip
         if "address" not in c.params:
             raise CmdError("arp add requires `address <ip>`")
         net.arps.record(_parse_ip_str(c.params["address"]),
-                        parse_mac(c.alias))
+                        _parse_mac_str(c.alias))
         return "OK"
     if c.action in ("list", "list-detail"):
         macs = {m: getattr(i, "name", "?") for m, i in net.macs.entries()}
@@ -878,7 +877,7 @@ def _h_ip(app: Application, c: Command):
     sw, net = _ctx_vpc(app, c)
     if c.action == "add":
         ip = _parse_ip_str(c.alias)
-        mac = (parse_mac(c.params["mac"]) if "mac" in c.params
+        mac = (_parse_mac_str(c.params["mac"]) if "mac" in c.params
                else synthetic_mac(net.vni, ip))
         net.ips.add(ip, mac)
         return "OK"
@@ -897,6 +896,14 @@ def _parse_ip_str(s: str) -> bytes:
         return _p(s)
     except (OSError, ValueError):
         raise CmdError(f"bad ip {s!r}")
+
+
+def _parse_mac_str(s: str) -> bytes:
+    from ..vswitch.packets import PacketError, parse_mac
+    try:
+        return parse_mac(s)
+    except (PacketError, ValueError):
+        raise CmdError(f"bad mac {s!r}")
 
 
 def _all_lbs(app: Application) -> dict:

@@ -181,7 +181,17 @@ class DockerNetworkDriver:
 
         script = self._ensure_post_script(endpoint_id, "")
         name = "tap" + endpoint_id[:12]
-        iface = sw.add_tap(name, net.vni, post_script=script, annotations=anno)
+        try:
+            iface = sw.add_tap(name, net.vni, post_script=script,
+                               annotations=anno)
+        except OSError:
+            # failed creates get no DeleteEndpoint from docker: don't
+            # leave a stray script behind
+            try:
+                os.unlink(script)
+            except OSError:
+                pass
+            raise
         _log.info(f"tap added: {iface.dev} vni={net.vni} "
                   f"endpointId={endpoint_id} ipv4={address} "
                   f"ipv6={address_v6} mac={mac}")
@@ -282,13 +292,26 @@ class DockerNetworkDriver:
 
 
 class DockerNetworkPluginController:
-    """The unix-socket HTTP half (DockerNetworkPluginController.java)."""
+    """The unix-socket HTTP half (DockerNetworkPluginController.java).
+
+    Driver calls run on a dedicated serializing thread, not the control
+    loop: tap post-scripts may block for seconds (netns operations) and
+    must not stall RESP/HTTP control traffic. Responses complete back on
+    the loop; request order is preserved (the reference serializes with
+    `synchronized` driver methods)."""
 
     def __init__(self, app, alias: str, path: str,
                  driver: Optional[DockerNetworkDriver] = None):
+        import queue
+        import threading
+        self.app = app
         self.alias = alias
         self.path = path
         self.driver = driver or DockerNetworkDriver(app)
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._worker = threading.Thread(target=self._drain, daemon=True,
+                                        name=f"docker-driver-{alias}")
+        self._worker.start()
         srv = HttpServer(app.control_loop)
         srv.post("/Plugin.Activate", self._activate)
         srv.post("/NetworkDriver.GetCapabilities", self._capabilities)
@@ -309,6 +332,14 @@ class DockerNetworkPluginController:
         # synchronous: `remove` must not report OK while the socket file
         # still accepts connections
         self.server.close(sync=True)
+        self._jobs.put(None)
+
+    def _drain(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            job()
 
     # ----------------------------------------------------------- handlers
 
@@ -327,16 +358,18 @@ class DockerNetworkPluginController:
         rctx.resp.end({"Scope": "local", "ConnectivityScope": "local"})
 
     def _run(self, rctx: RoutingContext, fn, ok=None) -> None:
-        try:
-            out = fn()
-        except DockerError as e:
-            rctx.resp.end({"Err": str(e)})
-            return
-        except Exception as e:  # switch/tap/OS failure
-            _log.alert(f"docker driver error: {e!r}")
-            rctx.resp.end({"Err": f"{type(e).__name__}: {e}"})
-            return
-        rctx.resp.end(out if out is not None else (ok or {}))
+        def job() -> None:
+            try:
+                out = fn()
+                res = out if out is not None else (ok or {})
+            except DockerError as e:
+                res = {"Err": str(e)}
+            except Exception as e:  # switch/tap/OS failure
+                _log.alert(f"docker driver error: {e!r}")
+                res = {"Err": f"{type(e).__name__}: {e}"}
+            # response completion must happen on the loop that owns the conn
+            self.app.control_loop.run_on_loop(lambda: rctx.resp.end(res))
+        self._jobs.put(job)
 
     def _create_network(self, rctx: RoutingContext) -> None:
         b = self._body(rctx)

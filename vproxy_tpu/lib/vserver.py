@@ -67,12 +67,16 @@ class Response:
 
 
 class RoutingContext:
-    def __init__(self, server: "HttpServer", conn: Connection, req: Request):
+    def __init__(self, server: "HttpServer", conn: Connection, req: Request,
+                 close_after: bool = False):
         self.server = server
         self.conn = conn
         self.req = req
         self.resp = Response(self)
         self._done = False
+        # `connection: close` requests tear down here, after the response
+        # is actually written — handlers may finish asynchronously
+        self._close_after = close_after
 
     def _finish(self, status: int, headers: list, body: bytes) -> None:
         if self._done:
@@ -88,6 +92,8 @@ class RoutingContext:
         head += "\r\n"
         self.conn.write(head.encode() + body)
         self.server._request_done(self.conn)
+        if self._close_after:
+            self.conn.close_graceful()
 
 
 def _match(route: str, path: str) -> Optional[dict]:
@@ -184,7 +190,7 @@ class HttpServer:
     def _request_done(self, conn: Connection) -> None: ...
 
     def _dispatch(self, conn: Connection, parser: HeadParser,
-                  body: bytes) -> None:
+                  body: bytes, close_after: bool = False) -> None:
         path, _, qs = (parser.uri or "/").partition("?")
         query = {k: v[-1] for k, v in parse_qs(qs).items()}
         for method, route, fn in self.routes:
@@ -194,14 +200,15 @@ class HttpServer:
             if params is None:
                 continue
             rctx = RoutingContext(self, conn, Request(parser, body, params,
-                                                      query))
+                                                      query), close_after)
             try:
                 fn(rctx)
             except Exception as e:  # handler error -> 500
                 if not rctx._done:
                     rctx.resp.status(500).end({"error": f"{type(e).__name__}: {e}"})
             return
-        rctx = RoutingContext(self, conn, Request(parser, body, {}, query))
+        rctx = RoutingContext(self, conn, Request(parser, body, {}, query),
+                              close_after)
         rctx.resp.status(404).end({"error": f"Cannot {parser.method} {path}"})
 
 
@@ -247,10 +254,9 @@ class _HttpSrvConn(Handler):
             self.parser = HeadParser()
             self.buf = bytearray(leftover)
             close = "close" in (parser.header("connection") or "").lower()
-            self.server._dispatch(self.conn, parser, body)
+            self.server._dispatch(self.conn, parser, body, close)
             if close:
-                self.conn.close_graceful()
-                return
+                return  # conn closes in _finish once the response is out
 
     def on_eof(self, conn: Connection) -> None:
         conn.close()

@@ -21,6 +21,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -101,16 +102,20 @@ int vtl_accept(int lfd, char* ipbuf, int ipbuflen, int* port) {
 int vtl_unix_listen(const char* path, int backlog) {
   sockaddr_un sa;
   if (strlen(path) >= sizeof(sa.sun_path)) return -ENAMETOOLONG;
-  int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (probe >= 0) {
-    memset(&sa, 0, sizeof(sa));
-    sa.sun_family = AF_UNIX;
-    strcpy(sa.sun_path, path);
-    if (connect(probe, (sockaddr*)&sa, sizeof(sa)) < 0 &&
-        (errno == ECONNREFUSED || errno == ENOENT)) {
-      unlink(path);  // dead leftover from a previous process
+  struct stat st;
+  if (stat(path, &st) == 0) {
+    if (!S_ISSOCK(st.st_mode)) return -EADDRINUSE;  // never unlink non-sockets
+    int probe = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (probe >= 0) {
+      memset(&sa, 0, sizeof(sa));
+      sa.sun_family = AF_UNIX;
+      strcpy(sa.sun_path, path);
+      if (connect(probe, (sockaddr*)&sa, sizeof(sa)) < 0 &&
+          (errno == ECONNREFUSED || errno == ENOENT)) {
+        unlink(path);  // dead leftover from a previous process
+      }
+      close(probe);
     }
-    close(probe);
   }
   int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (fd < 0) return -errno;
