@@ -1,0 +1,43 @@
+"""vfdtrace analog: VPROXY_TPU_FDTRACE wraps the syscall layer in call
+loggers (vfd/TraceInvocationHandler.java behind -Dvfdtrace=1)."""
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def test_fdtrace_logs_every_fd_op():
+    """Spawn a child with tracing on: every syscall-layer op it performs
+    must appear on stderr with args and results."""
+    code = (
+        "from vproxy_tpu.net import vtl\n"
+        "lfd = vtl.tcp_listen('127.0.0.1', 0)\n"
+        "ip, port = vtl.sock_name(lfd)\n"
+        "cfd = vtl.tcp_connect('127.0.0.1', port)\n"
+        "vtl.close(cfd)\n"
+        "vtl.close(lfd)\n"
+        "try:\n"
+        "    vtl.tcp_listen('300.1.1.1', 0)\n"
+        "except OSError:\n"
+        "    pass\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60,
+                       env={**os.environ, "PYTHONPATH": REPO,
+                            "JAX_PLATFORMS": "cpu",
+                            "VPROXY_TPU_FDTRACE": "1"})
+    assert r.returncode == 0, r.stderr
+    err = r.stderr
+    assert "[fdtrace] tcp_listen('127.0.0.1',0) -> " in err
+    assert "[fdtrace] sock_name(" in err
+    assert "[fdtrace] tcp_connect('127.0.0.1'," in err
+    assert "[fdtrace] close(" in err
+    # failures are traced too, with the raised error
+    assert "tcp_listen('300.1.1.1',0) !> " in err
+
+
+def test_fdtrace_off_by_default():
+    from vproxy_tpu.net import vtl
+    assert not vtl._trace_installed

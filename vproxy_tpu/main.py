@@ -13,6 +13,14 @@ Args (subset of the reference's op grammar, app/args/*):
   noSave                 disable auto/exit saving
   noStdIOController      do not start the stdin REPL
   workers <n>            worker event loops (default: cpu count)
+
+Env flags (the reference's -D system-property layer, Config.java):
+  VPROXY_TPU_LOG=debug|info|warn|error   log level filter
+  VPROXY_TPU_PROBE=ch1,ch2               targeted data-path probe channels
+  VPROXY_TPU_FDTRACE=1                   trace every FD syscall (-Dvfdtrace)
+  VPROXY_TPU_MATCHER=...                 classify backend override
+  VPROXY_TPU_WORKERS=n                   default worker loop count
+  VPROXY_TPU_HOME=dir                    config/persistence directory
 """
 from __future__ import annotations
 

@@ -171,3 +171,57 @@ def sock_name(fd: int, peer: bool = False):
     port = ctypes.c_int(0)
     check(LIB.vtl_sock_name(fd, 1 if peer else 0, buf, 64, ctypes.byref(port)))
     return buf.value.decode(), port.value
+
+
+# --------------------------------------------------------------- fdtrace
+
+_TRACED_FNS = ("tcp_listen", "accept", "tcp_connect", "finish_connect",
+               "unix_listen", "unix_connect", "udp_bind", "udp_socket",
+               "recvfrom", "sendto", "read", "write", "close",
+               "shutdown_wr", "set_nodelay", "sock_name")
+_trace_installed = False
+
+
+def _trace_fmt(v) -> str:
+    if isinstance(v, (bytes, bytearray)):
+        return f"<{len(v)}B>"
+    if isinstance(v, tuple):
+        return "(" + ",".join(_trace_fmt(x) for x in v) + ")"
+    return repr(v)
+
+
+def enable_fdtrace() -> None:
+    """Log every syscall-layer call with args and result — the
+    reference's `-Dvfdtrace=1` dynamic FD proxy
+    (vfd/TraceInvocationHandler.java, VFDConfig.java:21). Enabled at
+    import via VPROXY_TPU_FDTRACE=1 or programmatically; idempotent.
+    The C-internal splice pump and epoll loop are not traced (like the
+    reference, which wraps FDs, not libae internals)."""
+    global _trace_installed
+    if _trace_installed:
+        return
+    _trace_installed = True
+    import functools
+
+    from ..utils.log import Logger
+    log = Logger("fdtrace")
+    g = globals()
+    for name in _TRACED_FNS:
+        fn = g[name]
+
+        @functools.wraps(fn)
+        def traced(*a, __fn=fn, __name=name, **kw):
+            args = ",".join(_trace_fmt(x) for x in a)
+            try:
+                r = __fn(*a, **kw)
+            except OSError as e:
+                log.info(f"{__name}({args}) !> {e!r}")
+                raise
+            log.info(f"{__name}({args}) -> {_trace_fmt(r)}")
+            return r
+
+        g[name] = traced
+
+
+if os.environ.get("VPROXY_TPU_FDTRACE", "") == "1":
+    enable_fdtrace()
