@@ -749,6 +749,45 @@ def _run_host_stage(timeout):
     return {}
 
 
+def _run_switch_stage(timeout):
+    """bench_switch.py in a CPU-env subprocess: BASELINE config #4 —
+    50k-route LPM + 5k ACL synthetic packet replay through the real
+    switch data plane. Returns the switch_* fields or {}."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_switch.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["SWBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(f"# === stage switch (timeout {timeout:.0f}s) ===\n")
+    p = subprocess.Popen([sys.executable,
+                          os.path.join(here, "bench_switch.py")],
+                         env=env, cwd=here, stdout=sys.stderr)
+    sys.stderr.flush()
+    try:
+        p.wait(timeout)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write("# stage switch: timeout, SIGTERM\n")
+        p.terminate()
+        try:
+            p.wait(10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write("# stage switch: unkillable, abandoned\n")
+    if os.path.exists(result_file):
+        try:
+            with open(result_file) as f:
+                return json.load(f)
+        except ValueError:
+            pass
+    sys.stderr.write("# stage switch: no result\n")
+    return {}
+
+
 def _read_phases(phase_file):
     out = []
     if os.path.exists(phase_file):
@@ -804,6 +843,9 @@ def orchestrate():
     # host-path req/s (native splice pump) rides along in every run
     result.update(_run_host_stage(
         float(os.environ.get("BENCH_HOST_TIMEOUT", "120"))))
+    # switch data plane (BASELINE config #4) rides along too
+    result.update(_run_switch_stage(
+        float(os.environ.get("BENCH_SWITCH_TIMEOUT", "240"))))
     result["phases"] = _read_phases(phase_file)
     print(json.dumps(result))
     return 0

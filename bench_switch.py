@@ -1,0 +1,191 @@
+"""Switch data-plane benchmark — BASELINE config #4: 50k-route LPM +
+5k-ACL, synthetic L3 packet replay.
+
+Replays pre-serialized VXLAN datagrams through the REAL switch input
+path (Switch._input_batch: vxlan parse -> bare ACL -> L2 learn/forward
+-> L3 route LPM -> cross-VNI delivery -> egress serialization), the way
+the reference benches its switch with pcap replay. The burst path
+classifies the 5k-rule ACL and the 50k-route LPM in ONE matcher
+dispatch per burst (vswitch/switch.py RECV_BURST) — per-packet lookups
+on device tables would pay a dispatch per packet.
+
+Reported (merged into bench.py output):
+  switch_replay_pps        — packets/s through the data plane (classify
+                             backend = default / VPROXY_TPU_MATCHER)
+  switch_replay_pps_oracle — same replay, host-oracle matchers (the
+                             reference-style per-packet linear scan)
+  switch_routes / switch_acls / switch_burst / switch_pkts
+
+Env knobs: SWBENCH_ROUTES (50000), SWBENCH_ACLS (5000), SWBENCH_SECS
+(6), SWBENCH_PKTS (4096), SWBENCH_ORACLE_SECS (3).
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+
+def _env_int(k, d):
+    return int(os.environ.get(k, str(d)))
+
+
+def build_world(backend):
+    """Switch + 2 VPCs + 50k routes (vni1 -> vni2) + 5k-rule bare ACL +
+    egress counting iface. -> (loop, sw, counter, datagrams)"""
+    from vproxy_tpu.components.secgroup import SecurityGroup
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.rules.ir import AclRule, Proto, RouteRule
+    from vproxy_tpu.utils.ip import Network
+    from vproxy_tpu.vswitch.iface import Iface
+    from vproxy_tpu.vswitch.packets import Ethernet, Ipv4, Vxlan
+    from vproxy_tpu.vswitch.switch import Switch, synthetic_mac
+
+    n_routes = _env_int("SWBENCH_ROUTES", 50_000)
+    n_acls = _env_int("SWBENCH_ACLS", 5_000)
+    n_pkts = _env_int("SWBENCH_PKTS", 4096)
+
+    # 5k ACL rules that never match the senders (the replay pays the
+    # full first-match scan, then falls to default allow) — senders are
+    # 10.200/16, rules cover 172.16-235.x/24
+    acls = []
+    for i in range(n_acls):
+        acls.append(AclRule(
+            f"a{i}", Network.parse(f"172.{16 + (i >> 8) % 220}.{i & 255}.0/24"),
+            Proto.UDP, 0, 65535, (i & 1) == 0))
+    secg = SecurityGroup("bench-acl", default_allow=True, backend=backend)
+    secg.extend_rules(acls)
+
+    loop = SelectorEventLoop("swbench")
+    loop.loop_thread()
+    sw = Switch("swb", loop, "127.0.0.1", 0, bare_vxlan_access=secg,
+                matcher_backend=backend)
+    sw.start()
+    net1 = sw.add_network(1, Network.parse("10.0.0.0/8"))
+    net2 = sw.add_network(2, Network.parse("10.0.0.0/8"))
+
+    # switch-owned L3 entry mac in vni1 (packets addressed here route)
+    gw_ip = bytes([10, 0, 0, 1])
+    gw_mac = synthetic_mac(1, gw_ip)
+    net1.ips.add(gw_ip, gw_mac)
+    # source-mac picker for deliveries into vni2
+    src2 = bytes([10, 255, 255, 254])
+    net2.ips.add(src2, synthetic_mac(2, src2))
+
+    # 50k /24 routes: 10.a.b.0/24 -> vni 2. RouteTable insert keeps
+    # more-specific-first ordering; all /24 -> plain append (fast path).
+    routes = []
+    for i in range(n_routes):
+        a, b = 1 + (i >> 8) % 200, i & 255
+        routes.append(RouteRule(f"r{i}", Network.parse(f"10.{a}.{b}.0/24"),
+                                to_vni=2))
+    net1.routes.rules.extend(routes)  # bulk: one matcher sync below
+    net1.routes.rules_v4.extend(routes)
+    net1._sync_routes()
+
+    class CountingIface(Iface):
+        """Egress sink: serializes the frame (honest cost) and counts."""
+        name = "bench-out"
+        sent = 0
+
+        def send_vxlan(self, iface_sw, pkt) -> None:
+            pkt.to_bytes()
+            CountingIface.sent += 1
+
+    counter = CountingIface()
+    dst_mac = b"\x02\xfe\x00\x00\x00\x01"
+    net2.macs.record(dst_mac, counter)
+
+    # pre-serialized replay set: dsts spread across the route table
+    dgrams = []
+    for i in range(n_pkts):
+        a, b, c = 1 + (i >> 8) % 200, i & 255, 1 + (i % 250)
+        dst = bytes([10, a, b, c])
+        net2.arps.record(dst, dst_mac)
+        src_ip = bytes([10, 200, (i >> 8) & 255, i & 255])
+        ip = Ipv4(src=src_ip, dst=dst, proto=17, payload=b"x" * 18, ttl=64)
+        eth = Ethernet(gw_mac, b"\x02\xaa\x00\x00\x00\x01", 0x0800, b"",
+                       packet=ip)
+        data = Vxlan(1, eth).to_bytes()
+        dgrams.append((data, f"10.200.{(i >> 8) & 255}.{i & 255}", 4789))
+    return loop, sw, CountingIface, dgrams
+
+
+def replay(loop, sw, counter, dgrams, secs):
+    """Replay bursts on the loop thread until the window closes."""
+    burst = sw.RECV_BURST
+    chunks = [dgrams[i:i + burst] for i in range(0, len(dgrams), burst)]
+    # warmup: first burst pays the jit compiles
+    loop.call_sync(lambda: sw._input_batch(chunks[0]), timeout=600)
+    counter.sent = 0
+    n_in = 0
+    t0 = time.perf_counter()
+    deadline = t0 + secs
+    while time.perf_counter() < deadline:
+        for ch in chunks:
+            loop.call_sync(lambda c=ch: sw._input_batch(c), timeout=600)
+            n_in += len(ch)
+        if not sys.stdout.isatty():
+            sys.stderr.flush()
+    dt = time.perf_counter() - t0
+    return n_in, counter.sent, dt
+
+
+def main():
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    secs = float(os.environ.get("SWBENCH_SECS", "6"))
+    oracle_secs = float(os.environ.get("SWBENCH_ORACLE_SECS", "3"))
+    out_path = os.environ.get("SWBENCH_RESULT_FILE")
+    result = {}
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f)
+            os.replace(out_path + ".tmp", out_path)
+
+    loops = []
+    try:
+        t_build = time.time()
+        loop, sw, counter, dgrams = build_world(backend=None)
+        loops.append((loop, sw))
+        result["switch_build_s"] = round(time.time() - t_build, 2)
+        result["switch_routes"] = _env_int("SWBENCH_ROUTES", 50_000)
+        result["switch_acls"] = _env_int("SWBENCH_ACLS", 5_000)
+        result["switch_burst"] = sw.RECV_BURST
+        result["switch_pkts"] = len(dgrams)
+
+        n_in, n_out, dt = replay(loop, sw, counter, dgrams, secs)
+        if n_out < n_in:  # every admitted packet must come out routed
+            result["switch_error"] = f"delivered {n_out}/{n_in}"
+        result["switch_replay_pps"] = round(n_in / dt, 1)
+        result["switch_replay_secs"] = round(dt, 2)
+        flush()
+
+        # reference-style per-packet linear scan for context
+        loop2, sw2, counter2, dgrams2 = build_world(backend="host")
+        loops.append((loop2, sw2))
+        n_in2, n_out2, dt2 = replay(loop2, sw2, counter2, dgrams2,
+                                    oracle_secs)
+        result["switch_replay_pps_oracle"] = round(n_in2 / dt2, 1)
+        if n_out2 < n_in2:
+            result["switch_error_oracle"] = f"delivered {n_out2}/{n_in2}"
+        flush()
+    finally:
+        for lp, sw in loops:
+            try:
+                sw.stop()
+                lp.close()
+            except Exception:
+                pass
+
+    print(json.dumps(result))
+    flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

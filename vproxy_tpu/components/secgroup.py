@@ -48,6 +48,25 @@ class SecurityGroup:
             self._rules.append(rule)
             self._recalc(rule.protocol)
 
+    def extend_rules(self, rules: Sequence[AclRule]) -> None:
+        """Bulk add: one table recompile per touched protocol instead of
+        per rule (a 5k-rule group would otherwise pay 5k recompiles)."""
+        with self._lock:
+            seen = {r.alias for r in self._rules}
+            eq = {(r.network, r.protocol, r.min_port, r.max_port)
+                  for r in self._rules}
+            for r in rules:
+                if r.alias in seen:
+                    raise ValueError(f"rule {r.alias} already exists in {self.alias}")
+                k = (r.network, r.protocol, r.min_port, r.max_port)
+                if k in eq:
+                    raise ValueError(f"equivalent rule for {r.alias} already exists")
+                seen.add(r.alias)
+                eq.add(k)
+            self._rules.extend(rules)
+            for proto in {r.protocol for r in rules}:
+                self._recalc(proto)
+
     def remove_rule(self, alias: str) -> None:
         with self._lock:
             for i, r in enumerate(self._rules):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import struct
 from typing import Optional
 
+from ..utils.log import Logger
 from .network import VpcNetwork
 from .packets import (ARP_REPLY, ARP_REQUEST, BROADCAST_MAC, ETHER_TYPE_ARP,
                       ETHER_TYPE_IPV4, ETHER_TYPE_IPV6, ICMP_ECHO_REPLY,
@@ -19,6 +20,9 @@ from .packets import (ARP_REPLY, ARP_REQUEST, BROADCAST_MAC, ETHER_TYPE_ARP,
                       ICMPV6_ECHO_REQ, ICMPV6_NDP_NA, ICMPV6_NDP_NS,
                       PROTO_ICMP, PROTO_ICMPV6, PROTO_TCP, Arp, Ethernet,
                       Icmp, Icmpv6, Ipv4, Ipv6, Vxlan)
+
+
+_log = Logger("vswitch")
 
 
 def _is_multicast(mac: bytes) -> bool:
@@ -29,8 +33,31 @@ class NetworkStack:
     def __init__(self, sw):
         self.sw = sw  # Switch
         self.l4 = None  # installed by stack_tcp (task: user-space TCP)
+        # active burst collector: route() appends instead of looking up
+        self._route_pend: Optional[list] = None
 
     # ----------------------------------------------------------------- L2
+
+    def input_vxlan_batch(self, items) -> None:
+        """Process a drained burst [(Vxlan, iface)]: L2/ARP/ICMP run per
+        packet, but every route-needing packet's LPM lookup is collected
+        and classified in ONE matcher dispatch per (vpc, family) — on a
+        50k-route device table, per-packet match_one would pay a device
+        dispatch each; the burst amortizes it."""
+        pend: list = []
+        self._route_pend = pend
+        try:
+            for pkt, iface in items:
+                try:
+                    self.input_vxlan(pkt, iface)
+                except Exception as e:  # one bad frame must not kill the burst
+                    _log.warn(f"dropping frame from {iface.name}: {e!r}")
+        finally:
+            # flush inside finally: already-accepted packets' routes must
+            # not be dropped retroactively by a later failure
+            self._route_pend = None
+            if pend:
+                self._route_flush(pend)
 
     def input_vxlan(self, pkt: Vxlan, src_iface) -> None:
         net = self.sw.networks.get(pkt.vni)
@@ -174,7 +201,26 @@ class NetworkStack:
     def route(self, net: VpcNetwork, ether: Ethernet, ip, v6: bool) -> None:
         """L3.route(): LPM through the VPC route matcher; targets are
         another VNI (cross-VPC delivery) or a gateway IP."""
-        rule = net.route_lookup(ip.dst)
+        if self._route_pend is not None:  # burst mode: defer the lookup
+            self._route_pend.append((net, ether, ip, v6))
+            return
+        self._route_with(net, ether, ip, v6, net.route_lookup(ip.dst))
+
+    def _route_flush(self, pend: list) -> None:
+        groups: dict[int, list[int]] = {}
+        nets: dict[int, VpcNetwork] = {}
+        for i, (net, _e, _ip, _v) in enumerate(pend):
+            groups.setdefault(id(net), []).append(i)
+            nets[id(net)] = net
+        for key, idxs in groups.items():
+            net = nets[key]
+            rules = net.route_lookup_batch([pend[i][2].dst for i in idxs])
+            for i, rule in zip(idxs, rules):
+                n_, e_, ip_, v6_ = pend[i]
+                self._route_with(n_, e_, ip_, v6_, rule)
+
+    def _route_with(self, net: VpcNetwork, ether: Ethernet, ip, v6: bool,
+                    rule) -> None:
         if rule is None:
             return
         # ttl/hop-limit handling
