@@ -374,26 +374,34 @@ def child():
     @jax.jit
     def multi_fn(ht_, rt_, at_, hg_, rtg_, hqs, a16s_, fams_, portss_):
         """K classify steps per dispatch, verdicts reduced on device to
-        [K] u32 checksums (K*4 bytes d2h). Each iteration classifies the
-        full batch of query set i%S with ports rotated by i, so no two
-        iterations are loop-invariant and checksum[0] is reproducible by
-        step_fn on set 0 (verified below)."""
+        [K] u32 checksums (K*4 bytes d2h). The query sets unroll
+        STATICALLY inside each fori iteration — selecting the set with a
+        traced `i % S` index measured ~32ms/iteration of pure
+        dynamic_slice overhead through this backend (probe, r4) vs ~0
+        for static indexing; ports rotate by the iteration counter so no
+        step is loop-invariant. acc[i, s] = checksum of set s at
+        rotation i; chks[0] (i=0, s=0, identity rotation) stays
+        reproducible by step_fn on set 0 (verified below)."""
         s_count = fams_.shape[0]
 
         def body(i, acc):
-            s = i % s_count
-            hq = {k: v[s] for k, v in hqs.items()}
-            # rotate BOTH port legs by i (identity at i=0, so chks[0]
-            # stays reproducible by step_fn): with the set selection
-            # this makes every leg of every iteration i-dependent
-            hq = dict(hq, port=(hq["port"] + i) % 65536)
-            port = (portss_[s] + i) % 65536
-            v = _verdict(ht_, rt_, at_, hg_, rtg_, hq,
-                         a16s_[s], fams_[s], port)
-            return acc.at[i].set(jnp.sum(v.astype(jnp.uint32)))
+            for s in range(s_count):  # static unroll: no dynamic_slice
+                hq = {k: v[s] for k, v in hqs.items()}
+                hq = dict(hq, port=(hq["port"] + i) % 65536)
+                port = (portss_[s] + i) % 65536
+                v = _verdict(ht_, rt_, at_, hg_, rtg_, hq,
+                             a16s_[s], fams_[s], port)
+                acc = acc.at[i, s].set(jnp.sum(v.astype(jnp.uint32)))
+            return acc
 
-        return jax.lax.fori_loop(0, ksteps, body,
-                                 jnp.zeros(ksteps, jnp.uint32))
+        out = jax.lax.fori_loop(0, ksteps // s_count, body,
+                                jnp.zeros((ksteps // s_count, s_count),
+                                          jnp.uint32))
+        return out.reshape(-1)
+
+    # steps per dispatch must divide evenly into iterations x sets
+    # (floor to a multiple of nq, but never to 0)
+    ksteps = max(nq, (ksteps // nq) * nq)
 
     def submit(ds):
         hq, a16, fam, ports = ds

@@ -252,3 +252,77 @@ def test_engine_fp_vs_host_cross_check():
     m = CidrMatcher(nets, backend="jax-fp")
     assert m.match([parse_ip("10.1.2.3")])[0] == 0
     assert m.match([parse_ip("11.0.0.1")])[0] == -1
+
+
+def test_cidr_fp_trie_first_match_not_lpm():
+    """The v4 trie must honor FIRST-match in list order, which differs
+    from longest-prefix when a wide rule precedes a narrow one."""
+    wide = Network(parse_ip("10.0.0.0"), mask_bytes(8))
+    narrow = Network(parse_ip("10.1.0.0"), mask_bytes(16))
+    narrower = Network(parse_ip("10.1.2.0"), mask_bytes(24))
+    nets = [wide, narrow, narrower]  # wide FIRST: it wins everywhere in 10/8
+    tab = F.compile_cidr_fp(nets)
+    assert "t_l0" in tab.arrays
+    addrs = [bytes([10, 1, 2, 3]), bytes([10, 1, 9, 9]), bytes([10, 9, 9, 9]),
+             bytes([11, 0, 0, 1])]
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam, None))
+    assert got.tolist() == [0, 0, 0, -1]
+    # reversed: most-specific-first (the RouteTable ordering)
+    tab2 = F.compile_cidr_fp(nets[::-1])
+    got2 = np.asarray(F.cidr_fp_match(tab2.arrays, a16, fam, None))
+    assert got2.tolist() == [0, 1, 2, -1]
+    # v4-mapped v6 queries still resolve through the group path
+    mapped = [b"\x00" * 10 + b"\xff\xff" + bytes([10, 1, 2, 3])]
+    a16m, famm = T.encode_ips(mapped)
+    assert np.asarray(F.cidr_fp_match(tab.arrays, a16m, famm, None)).tolist() == [0]
+
+
+def test_cidr_fp_trie_acl_overlap_stack():
+    """ACL trie: overlapping CIDRs with interleaved port ranges keep
+    exact first-match semantics per (addr, port)."""
+    import random
+    rnd2 = random.Random(7)
+    acl = []
+    for i in range(60):
+        ml = rnd2.choice([0, 8, 16, 20, 24, 28, 32])
+        ip = bytes([10, rnd2.randint(0, 3), rnd2.randint(0, 255),
+                    rnd2.randint(0, 255)])
+        m = mask_bytes(ml)
+        net = Network(bytes(np.frombuffer(ip, np.uint8) &
+                            np.frombuffer(m, np.uint8)), m)
+        lo = rnd2.randint(0, 60000)
+        hi = min(65535, lo + rnd2.choice([0, 10, 5000, 65535]))
+        r = AclRule(f"x{i}", net, Proto.TCP, lo, hi, bool(i & 1))
+        if any(q.network == r.network and q.min_port == r.min_port
+               and q.max_port == r.max_port for q in acl):
+            continue
+        acl.append(r)
+    nets = [r.network for r in acl]
+    tab = F.compile_cidr_fp(nets, acl=acl)
+    addrs, ports = [], []
+    for _ in range(300):
+        addrs.append(bytes([10, rnd2.randint(0, 4), rnd2.randint(0, 255),
+                            rnd2.randint(0, 255)]))
+        ports.append(rnd2.randint(0, 65535))
+    a16, fam = T.encode_ips(addrs)
+    got = np.asarray(F.cidr_fp_match(tab.arrays, a16, fam,
+                                     np.asarray(ports, np.int32)))
+    for i, (a, p) in enumerate(zip(addrs, ports)):
+        want = next((j for j, r in enumerate(acl)
+                     if r.network.contains_ip(a)
+                     and r.min_port <= p <= r.max_port), -1)
+        assert got[i] == want, (i, a.hex(), p, int(got[i]), want)
+
+
+def test_cidr_fp_trie_fallback_no_trie_cap():
+    """caps carrying no_trie force the group-only build; results agree."""
+    nets = [Network(parse_ip(f"10.{i}.0.0"), mask_bytes(16)) for i in range(20)]
+    t1 = F.compile_cidr_fp(nets)
+    t2 = F.compile_cidr_fp(nets, caps={"no_trie": 1})
+    assert "t_l0" in t1.arrays and "t_l0" not in t2.arrays
+    addrs = [bytes([10, i, 1, 1]) for i in range(22)]
+    a16, fam = T.encode_ips(addrs)
+    g1 = np.asarray(F.cidr_fp_match(t1.arrays, a16, fam, None))
+    g2 = np.asarray(F.cidr_fp_match(t2.arrays, a16, fam, None))
+    assert g1.tolist() == g2.tolist()

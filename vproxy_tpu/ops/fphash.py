@@ -617,6 +617,174 @@ def _prune_acl_members(items: list, acl) -> list:
     return keep
 
 
+# ------------------------------------------------- v4 direct-index trie
+#
+# Every V4-family pattern is a contiguous-prefix mask over the low 32
+# bits (_expand_patterns), so the whole V4 side compresses into a 16/8/8
+# direct-index trie: 3 scalar gathers per query instead of one wide row
+# gather per (query, mask-group). Under the measured ~7ns/gathered-row
+# cost model (PERF_NOTES.md) that turns the 0.10-0.26us per-query group
+# scan into ~0.02us. Semantics are exact: each cell resolves to the
+# FIRST-matching rule in list order (min index among covering patterns)
+# — route mode paints cells in descending rule order so the lowest index
+# lands last; ACL cells keep the full pruned covering-rule list in
+# `mrows` so the port filter still picks the first match.
+#
+# Cell encoding (i32): <0 -> next-level table id (-(id+1)); route mode:
+# 0 = miss, v>0 = rule idx + 1; ACL mode: v>=0 = member-row id (row 0 is
+# the all-empty row = miss).
+
+_TRIE_TOUCH_LIMIT = 3_000_000  # build-cost guard: fall back to groups
+
+
+def _trie4_tables(pats4: list, caps: dict):
+    """Phase A — allocate subtables. pats4: [(key4, masklen, idx)].
+    -> (l0_ptr [65536], l1_ptr [S1cap,256], sub-counts S1, S2)."""
+    l0_ptr = np.full(65536, -1, np.int64)
+    n_s1 = 0
+    for key, m, _ in pats4:
+        if m > 16:
+            h = (key[0] << 8) | key[1]
+            if l0_ptr[h] < 0:
+                l0_ptr[h] = n_s1
+                n_s1 += 1
+    s1_cap = max(caps.get("S1", 0), _pow2(max(n_s1, 1), 4))
+    if n_s1 > s1_cap:
+        s1_cap = _pow2(n_s1, 4)
+    l1_ptr = np.full((s1_cap, 256), -1, np.int64)
+    n_s2 = 0
+    for key, m, _ in pats4:
+        if m > 24:
+            s = l0_ptr[(key[0] << 8) | key[1]]
+            if l1_ptr[s, key[2]] < 0:
+                l1_ptr[s, key[2]] = n_s2
+                n_s2 += 1
+    s2_cap = max(caps.get("S2", 0), _pow2(max(n_s2, 1), 4))
+    if n_s2 > s2_cap:
+        s2_cap = _pow2(n_s2, 4)
+    return l0_ptr, l1_ptr, s1_cap, s2_cap
+
+
+def _trie4_paint_route(pats4: list, caps: dict) -> dict:
+    """Route cells: min rule idx among covering patterns (descending
+    paint order; numpy range writes)."""
+    l0_ptr, l1_ptr, s1_cap, s2_cap = _trie4_tables(pats4, caps)
+    l0_val = np.zeros(65536, np.int64)
+    l1_val = np.zeros((s1_cap, 256), np.int64)
+    l2_val = np.zeros((s2_cap, 256), np.int64)
+    for key, m, idx in sorted(pats4, key=lambda p: -p[2]):
+        v = idx + 1
+        if m <= 16:
+            lo = (key[0] << 8) | key[1]
+            hi = lo + (1 << (16 - m))
+            l0_val[lo:hi] = v
+            subs = l0_ptr[lo:hi]
+            subs = np.unique(subs[subs >= 0])
+            if subs.size:
+                l1_val[subs] = v
+                l2s = l1_ptr[subs]
+                l2s = np.unique(l2s[l2s >= 0])
+                if l2s.size:
+                    l2_val[l2s] = v
+        elif m <= 24:
+            s = l0_ptr[(key[0] << 8) | key[1]]
+            lo = key[2]
+            hi = lo + (1 << (24 - m))
+            l1_val[s, lo:hi] = v
+            l2s = l1_ptr[s, lo:hi]
+            l2s = np.unique(l2s[l2s >= 0])
+            if l2s.size:
+                l2_val[l2s] = v
+        else:
+            t2 = l1_ptr[l0_ptr[(key[0] << 8) | key[1]], key[2]]
+            lo = key[3]
+            l2_val[t2, lo: lo + (1 << (32 - m))] = v
+    return _trie4_pack(
+        np.where(l0_ptr >= 0, -(l0_ptr + 1), l0_val),
+        np.where(l1_ptr >= 0, -(l1_ptr + 1), l1_val),
+        l2_val, s1_cap, s2_cap)
+
+
+def _trie4_pack(l0, l1, l2, s1_cap, s2_cap) -> dict:
+    """Flat levels walked with scalar gathers. A [N/16, 16] row-packed
+    variant with one-hot selects probed 3x faster in isolation, but
+    MISCOMPILED under the axon backend (step_fn diverged from the
+    oracle while the identical math passed on CPU) and bought nothing
+    inside the fused step — keep the verified layout."""
+    return {"t_l0": l0.astype(np.int32),
+            "t_l1": l1.astype(np.int32).reshape(-1),
+            "t_l2": l2.astype(np.int32).reshape(-1),
+            "S1": s1_cap, "S2": s2_cap}
+
+
+def _trie4_cells_acl(pats4: list, caps: dict):
+    """ACL cells: the ordered covering-rule LIST per cell (first-match
+    with port ranges can't reduce to one winner at build time). Returns
+    the raw (l0_ptr, l1_ptr, s1_cap, s2_cap, cell -> rule list) tuple;
+    compile_cidr_fp prunes the lists, assigns member rows and encodes
+    the level tables. Raises FpBuildError when the build-cost guard
+    trips (caller falls back to mask groups)."""
+    l0_ptr, l1_ptr, s1_cap, s2_cap = _trie4_tables(pats4, caps)
+    touches = 0
+    for key, m, _ in pats4:
+        if m <= 16:
+            lo = (key[0] << 8) | key[1]
+            span = 1 << (16 - m)
+            touches += span
+            subs = l0_ptr[lo: lo + span]
+            subs = subs[subs >= 0]
+            touches += subs.size * 256
+            # descending into every l2 under the covered l1 cells too
+            touches += int((l1_ptr[subs] >= 0).sum()) * 256
+        elif m <= 24:
+            s = l0_ptr[(key[0] << 8) | key[1]]
+            lo = key[2]
+            span = 1 << (24 - m)
+            touches += span
+            touches += int((l1_ptr[s, lo: lo + span] >= 0).sum()) * 256
+        else:
+            touches += 1 << (32 - m)
+    if touches > _TRIE_TOUCH_LIMIT:
+        raise FpBuildError(f"acl trie too wide to build ({touches} cell"
+                           " touches)")
+    lists: dict = {}  # cell key -> [rule idx ...] ascending by paint order
+
+    def add(cell, idx):
+        lists.setdefault(cell, []).append(idx)
+
+    for key, m, idx in sorted(pats4, key=lambda p: p[2]):
+        if m <= 16:
+            lo = (key[0] << 8) | key[1]
+            for c in range(lo, lo + (1 << (16 - m))):
+                s = l0_ptr[c]
+                if s < 0:
+                    add(("0", c), idx)
+                else:
+                    for c1 in range(256):
+                        t2 = l1_ptr[s, c1]
+                        if t2 < 0:
+                            add(("1", s, c1), idx)
+                        else:
+                            for c2 in range(256):
+                                add(("2", t2, c2), idx)
+        elif m <= 24:
+            s = l0_ptr[(key[0] << 8) | key[1]]
+            lo = key[2]
+            for c1 in range(lo, lo + (1 << (24 - m))):
+                t2 = l1_ptr[s, c1]
+                if t2 < 0:
+                    add(("1", s, c1), idx)
+                else:
+                    for c2 in range(256):
+                        add(("2", t2, c2), idx)
+        else:
+            t2 = l1_ptr[l0_ptr[(key[0] << 8) | key[1]], key[2]]
+            lo = key[3]
+            for c2 in range(lo, lo + (1 << (32 - m))):
+                add(("2", t2, c2), idx)
+    return l0_ptr, l1_ptr, s1_cap, s2_cap, lists
+
+
 def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
                     caps: Optional[dict] = None,
                     strict: bool = True) -> FpCidrTable:
@@ -626,14 +794,45 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
     if n > r_cap:
         r_cap = _pad_cap(n, 256)
 
-    groups: dict[tuple, dict[bytes, list[int]]] = {}
+    all_pats = []  # (key16, mask16, fam, rule idx)
     for i, net in enumerate(networks):
         for key, mask, fam in _expand_patterns(net):
+            all_pats.append((key, mask, fam, i))
+
+    import os as _os
+    if _os.environ.get("VPROXY_TPU_NO_TRIE"):
+        caps["no_trie"] = 1  # A/B escape hatch: force the group-only build
+    use_trie = not caps.get("no_trie")
+    groups: dict[tuple, dict[bytes, list[int]]] = {}
+    pats4 = []  # (key4, masklen, rule idx) — contiguous-prefix by construction
+    for key, mask, fam, i in all_pats:
+        if fam == V4 and use_trie:
+            m = bin(int.from_bytes(mask[12:], "big")).count("1")
+            pats4.append((key[12:], m, i))
+        else:
             groups.setdefault((fam, mask), {}).setdefault(key, []).append(i)
+
+    trie = None
+    trie_acl = None
+    if use_trie:
+        try:
+            if acl is None:
+                trie = _trie4_paint_route(pats4, caps)
+            else:
+                trie_acl = _trie4_cells_acl(pats4, caps)
+        except FpBuildError:
+            caps["no_trie"] = 1
+            use_trie = False
+            for key, mask, fam, i in all_pats:
+                if fam == V4:
+                    groups.setdefault((fam, mask), {}).setdefault(key, []).append(i)
 
     g4 = sorted(k for k in groups if k[0] == V4)
     g6 = sorted(k for k in groups if k[0] != V4)
-    n4 = max(caps.get("n4", 0), _pow2(max(len(g4), 1), 4))
+    if use_trie:
+        n4 = 0  # the trie serves every V4-family pattern
+    else:
+        n4 = max(caps.get("n4", 0), _pow2(max(len(g4), 1), 4))
     if len(g4) > n4:
         n4 = _pow2(len(g4), 4)
     n6 = max(caps.get("n6", 0), _pow2(max(len(g6), 1), 4))
@@ -642,11 +841,28 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
     g_cap = n4 + n6
 
     mk = 1
+    trie_lists: list = []      # unique pruned covering lists (trie ACL)
+    trie_list_ids: dict = {}   # tuple(list) -> position in trie_lists
     if acl is not None:
         for buckets in groups.values():
             for k in buckets:
                 buckets[k] = _prune_acl_members(buckets[k], acl)
                 mk = max(mk, len(buckets[k]))
+        if trie_acl is not None:
+            cells = trie_acl[4]
+            for cell, items in cells.items():
+                pruned = _prune_acl_members(items, acl)
+                tup = tuple(pruned)
+                if tup not in trie_list_ids:
+                    trie_list_ids[tup] = len(trie_lists)
+                    trie_lists.append(pruned)
+                cells[cell] = tup
+                mk = max(mk, len(pruned))
+            if mk > 128:
+                # degenerate stacking: rebuild without the trie
+                caps["no_trie"] = 1
+                return compile_cidr_fp(networks, acl=acl, caps=caps,
+                                       strict=strict)
     # both modes use 3-lane slot entries: route = (fp, fp, min idx);
     # ACL = (fp, fp, member-row id) with the (idx, port-range) members
     # in a SECOND narrow table — a query reads the slot row for every
@@ -693,7 +909,7 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
     if E > 128:
         raise FpBuildError(f"degenerate slot pileup: E={E}")
     n_keys = sum(len(groups[k]) for k in groups)
-    nm = max(caps.get("nm", 0), _pow2(n_keys + 1, 256))
+    nm = max(caps.get("nm", 0), _pow2(n_keys + len(trie_lists) + 1, 256))
     ct = max(caps.get("ct", 0), _pow2(max(off, 1), 256))
     rec = np.zeros((ct, E * ew), np.int32)
     mrows = np.full((nm if acl is not None else 1, 2 * Mk), -1, np.int32)
@@ -716,6 +932,36 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
                         (r.min_port & 0xFFFF) | ((r.max_port & 0xFFFF) << 16))
                 rec[row, j * ew: j * ew + 3] = [_i32(f1), _i32(f2), mrow]
 
+    if trie_acl is not None:
+        # member rows for the trie's per-cell covering lists, then the
+        # encoded cell tables (cell value = member-row id, 0 = miss)
+        l0_ptr, l1_ptr, s1_cap, s2_cap, cells = trie_acl
+        row_of = {}
+        for tup, _pos in trie_list_ids.items():
+            row = next_mrow
+            next_mrow += 1
+            for mi, ridx in enumerate(tup):
+                r = acl[ridx]
+                mrows[row, 2 * mi] = ridx
+                mrows[row, 2 * mi + 1] = _i32(
+                    (r.min_port & 0xFFFF) | ((r.max_port & 0xFFFF) << 16))
+            row_of[tup] = row
+        l0_val = np.zeros(65536, np.int64)
+        l1_val = np.zeros((s1_cap, 256), np.int64)
+        l2_val = np.zeros((s2_cap, 256), np.int64)
+        for cell, tup in cells.items():
+            v = row_of[tup]
+            if cell[0] == "0":
+                l0_val[cell[1]] = v
+            elif cell[0] == "1":
+                l1_val[cell[1], cell[2]] = v
+            else:
+                l2_val[cell[1], cell[2]] = v
+        trie = _trie4_pack(
+            np.where(l0_ptr >= 0, -(l0_ptr + 1), l0_val),
+            np.where(l1_ptr >= 0, -(l1_ptr + 1), l1_val),
+            l2_val, s1_cap, s2_cap)
+
     allow = np.zeros(r_cap, bool)
     if acl is not None:
         for i, r in enumerate(acl):
@@ -733,6 +979,14 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
         arrays["mrows"] = mrows
     new_caps = {"r_cap": r_cap, "n4": n4, "n6": n6, "E": E, "ct": ct,
                 "Mk": Mk, "nm": nm}
+    if trie is not None:
+        arrays["t_l0"] = trie["t_l0"]
+        arrays["t_l1"] = trie["t_l1"]
+        arrays["t_l2"] = trie["t_l2"]
+        new_caps["S1"] = trie["S1"]
+        new_caps["S2"] = trie["S2"]
+    if caps.get("no_trie"):
+        new_caps["no_trie"] = 1
     if strict and caps and any(caps.get(k, 0) and new_caps[k] > caps[k]
                                for k in new_caps):
         raise CapsExceeded(f"update outgrew reused caps: {caps} -> {new_caps}")
@@ -740,51 +994,91 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
                        caps=new_caps)
 
 
-def cidr_fp_match(t: dict, addr16: jnp.ndarray, fam: jnp.ndarray,
-                  port: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """-> first-matching rule index [B] i32 (ordered-scan semantics), -1
-    if none. One wide row gather per (query, group)."""
-    import jax.lax as lax
+def _trie4_lookup(t: dict, addr16: jnp.ndarray) -> jnp.ndarray:
+    """3 scalar gathers: 16/8/8 direct-index walk on the low 32 bits.
+    -> raw cell value [B] (route: idx+1, 0 miss; ACL: member-row id)."""
+    a = addr16.astype(jnp.int32)
+    v0 = t["t_l0"][a[:, 12] * 256 + a[:, 13]]
+    s1 = jnp.where(v0 < 0, -v0 - 1, 0)
+    v1 = t["t_l1"][s1 * 256 + a[:, 14]]
+    r1 = jnp.where(v0 < 0, v1, v0)
+    s2 = jnp.where(r1 < 0, -r1 - 1, 0)
+    v2 = t["t_l2"][s2 * 256 + a[:, 15]]
+    return jnp.where(r1 < 0, v2, r1)
 
-    r_cap = t["rcap_iota"].shape[0]
-    b = addr16.shape[0]
-    E = t["e_m"].shape[0]
-    ew = t["rec"].shape[1] // E
 
-    aw = _pack_words16_dev(addr16)  # [B, 4] u32
-    masked = aw[:, None, :] & t["g_mask4"][None]  # [B, G, 4]
-    hs = _fnv32_words_dev(masked, t["g_salt_s"])
-    f1 = lax.bitcast_convert_type(
-        _fnv32_words_dev(masked, t["g_salt_f1"]), jnp.int32)
-    f2 = lax.bitcast_convert_type(
-        _fnv32_words_dev(masked, t["g_salt_f2"]), jnp.int32)
-    slot = t["g_off"][None] + (hs & t["g_capmask"].astype(jnp.uint32)[None]
-                               ).astype(jnp.int32)
-    rows = t["rec"][slot]  # [B, G, E*ew] — THE gather
-    gok = (t["g_fam"][None] >= 0) & (fam[:, None] == t["g_fam"][None])
-    ents = rows.reshape(b, -1, E, ew)
-    eok = (ents[..., 0] == f1[:, :, None]) & (ents[..., 1] == f2[:, :, None]) \
-        & gok[:, :, None]
-    if "mrows" not in t:  # route: entry carries its bucket's min index
-        idx = jnp.where(eok, ents[..., 2], r_cap)
-        first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
-        return jnp.where(first < r_cap, first, -1)
-    # ACL: entry carries a member-row id; at most ONE entry per group
-    # matches (distinct keys under one mask), so the per-group winner
-    # reduces to a single member-row gather of (idx, lo|hi<<16) pairs
-    mrow = jnp.max(jnp.where(eok, ents[..., 2], 0), axis=2)  # [B, G]
-    mem = t["mrows"][mrow]  # [B, G, 2*Mk] — narrow second-level gather
-    mem = mem.reshape(b, mrow.shape[1], -1, 2)
+def _acl_first(mem: jnp.ndarray, port: Optional[jnp.ndarray],
+               r_cap: int) -> jnp.ndarray:
+    """mem [B, X, 2] (idx, lo|hi<<16) -> first matching idx or r_cap."""
     midx = mem[..., 0]
     valid = midx >= 0
     if port is not None:
         ports = mem[..., 1]
         lo = ports & 0xFFFF
         hi = (ports >> 16) & 0xFFFF
-        p = port[:, None, None]
+        p = port[:, None]
         valid = valid & (lo <= p) & (p <= hi)
-    idx = jnp.where(valid, midx, r_cap)
-    first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
+    b = mem.shape[0]
+    return jnp.min(jnp.where(valid, midx, r_cap).reshape(b, -1), axis=1)
+
+
+def cidr_fp_match(t: dict, addr16: jnp.ndarray, fam: jnp.ndarray,
+                  port: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """-> first-matching rule index [B] i32 (ordered-scan semantics), -1
+    if none. V4-family queries walk the direct-index trie (3 scalar
+    gathers); V6-family queries pay one wide row gather per group."""
+    import jax.lax as lax
+
+    r_cap = t["rcap_iota"].shape[0]
+    b = addr16.shape[0]
+    E = t["e_m"].shape[0]
+    ew = t["rec"].shape[1] // E
+    G = t["g_fam"].shape[0]
+    acl_mode = "mrows" in t
+    have_trie = "t_l0" in t
+
+    eok = ents = None
+    if G:
+        aw = _pack_words16_dev(addr16)  # [B, 4] u32
+        masked = aw[:, None, :] & t["g_mask4"][None]  # [B, G, 4]
+        hs = _fnv32_words_dev(masked, t["g_salt_s"])
+        f1 = lax.bitcast_convert_type(
+            _fnv32_words_dev(masked, t["g_salt_f1"]), jnp.int32)
+        f2 = lax.bitcast_convert_type(
+            _fnv32_words_dev(masked, t["g_salt_f2"]), jnp.int32)
+        slot = t["g_off"][None] + (hs & t["g_capmask"].astype(jnp.uint32)[None]
+                                   ).astype(jnp.int32)
+        rows = t["rec"][slot]  # [B, G, E*ew] — THE gather
+        gok = (t["g_fam"][None] >= 0) & (fam[:, None] == t["g_fam"][None])
+        ents = rows.reshape(b, -1, E, ew)
+        eok = (ents[..., 0] == f1[:, :, None]) & (ents[..., 1] == f2[:, :, None]) \
+            & gok[:, :, None]
+
+    if not acl_mode:  # route: entry carries its bucket's min index
+        first = jnp.full(b, r_cap, jnp.int32)
+        if G:
+            idx = jnp.where(eok, ents[..., 2], r_cap)
+            first = jnp.min(idx.reshape(b, -1), axis=1).astype(jnp.int32)
+        if have_trie:
+            tri = (_trie4_lookup(t, addr16) - 1).astype(jnp.int32)
+            tri = jnp.where(tri >= 0, tri, r_cap)
+            first = jnp.where(fam == V4, tri, first)
+        return jnp.where(first < r_cap, first, -1)
+
+    # ACL: entry carries a member-row id; at most ONE entry per group
+    # matches (distinct keys under one mask), so the per-group winner
+    # reduces to a single member-row gather of (idx, lo|hi<<16) pairs
+    first = jnp.full(b, r_cap, jnp.int32)
+    if G:
+        mrow = jnp.max(jnp.where(eok, ents[..., 2], 0), axis=2)  # [B, G]
+        mem = t["mrows"][mrow]  # [B, G, 2*Mk] — narrow second-level gather
+        first = _acl_first(mem.reshape(b, -1, 2), port, r_cap).astype(jnp.int32)
+    if have_trie:
+        mrow_t = _trie4_lookup(t, addr16)  # [B] member-row id (0 = miss)
+        mem_t = t["mrows"][mrow_t]  # [B, 2*Mk]
+        first_t = _acl_first(mem_t.reshape(b, -1, 2), port,
+                             r_cap).astype(jnp.int32)
+        first = jnp.where(fam == V4, first_t, first)
     return jnp.where(first < r_cap, first, -1)
 
 
