@@ -116,12 +116,13 @@ def kernel_select():
         from vproxy_tpu.ops import fphash as F
         return (F.compile_hint_fp, F.compile_cidr_fp,
                 F.encode_hint_queries_fp, F.hint_fp_match, F.cidr_fp_match,
-                ("hp_slot", "hp_fp1", "hp_fp2", "hp_level"))
+                ("hp_slot", "hp_fp1", "hp_fp2", "hp_level"),
+                ("up_slot", "up_fp1", "up_fp2", "up_score"))
     from vproxy_tpu.ops import hashmatch as H
     return (H.compile_hint_hash,
             lambda nets, acl=None: H.compile_cidr_hash(nets, acl=acl),
             H.encode_hint_queries, H.hint_hash_match, H.cidr_hash_match,
-            ("hp_len", "hp_slot1", "hp_slot2"))
+            ("hp_len", "hp_slot1", "hp_slot2"), ())
 
 
 def build(ph):
@@ -165,7 +166,8 @@ def build(ph):
     acls = [AclRule(f"r{i}", v4net(i * 3, 8 + (i % 25)), Proto.TCP,
                     (i * 7) % 60000, (i * 7) % 60000 + 1000, i % 2 == 0)
             for i in range(n_acl)]
-    compile_hint, compile_cidr, encode_hints, _, _, pad_keys = kernel_select()
+    (compile_hint, compile_cidr, encode_hints, _, _, pad_keys,
+     upad_keys) = kernel_select()
     ht = compile_hint(hint_rules)
     rt = compile_cidr(routes)
     at = compile_cidr([r.network for r in acls], acl=acls)
@@ -202,16 +204,27 @@ def build(ph):
         if s == 0:
             sample_hints, sample_addrs = hints[:8], addrs[:8]
 
-    # unify the host-probe tier across sets so they stack on one axis
-    # (invalid pad: -1 lens for cuckoo, level/slot 0 for fp)
-    maxp = max(q[0][pad_keys[0]].shape[1] for q in qsets)
+    # unify the probe tiers across sets so they stack on one axis
+    # (invalid pad: -1 lens for cuckoo, level/slot 0 for fp); the fp
+    # uri probes are content-trimmed per set and need the same treatment
     padval = -1 if pad_keys[0] == "hp_len" else 0
-    for hq, _, _, _ in qsets:
-        cur = hq[pad_keys[0]].shape[1]
-        if cur < maxp:
-            pad = np.full((batch, maxp - cur), padval, np.int32)
-            for k in pad_keys:
-                hq[k] = np.concatenate([hq[k], pad], axis=1)
+    # um_* exist iff that set's uri probes were trimmed; sets must agree
+    # on the key set to stack (and the fallback reads up_* PRE-padding)
+    if any("um_fp1" in q[0] for q in qsets):
+        for hq, _, _, _ in qsets:
+            for mk_, pk_ in (("um_fp1", "up_fp1"), ("um_fp2", "up_fp2"),
+                             ("um_score", "up_score")):
+                hq.setdefault(mk_, hq[pk_])
+    for keys in (pad_keys, upad_keys):
+        if not keys:
+            continue
+        maxp = max(q[0][keys[0]].shape[1] for q in qsets)
+        for hq, _, _, _ in qsets:
+            cur = hq[keys[0]].shape[1]
+            if cur < maxp:
+                pad = np.full((batch, maxp - cur), padval, np.int32)
+                for k in keys:
+                    hq[k] = np.concatenate([hq[k], pad], axis=1)
     ph.done(batch=batch, sets=nq)
 
     # host-side oracle answers for the first 8 set-0 queries — the
@@ -287,7 +300,7 @@ def child():
     ph.done(platform=platform, n=len(jax.devices()))
 
     from vproxy_tpu.rules.engine import _to_device
-    _, _, _, hint_match, cidr_match, _ = kernel_select()
+    _, _, _, hint_match, cidr_match, _, _ = kernel_select()
 
     n_groups = _env_int("BENCH_GROUPS", 251)
     n_nexthop = _env_int("BENCH_NEXTHOPS", 120)

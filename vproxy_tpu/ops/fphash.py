@@ -444,7 +444,28 @@ def encode_hint_queries_fp(hints: Sequence, tab: FpHintTable) -> dict:
     up_fp2 = np.where(lv, np.take_along_axis(us[2], ll, 1), 0)
     up_score = np.where(lv, np.minimum(ll + 1, URI_MAX_SCORE), 0)
 
+    # The probe arrays are TRIMMED to the batch's live probe count —
+    # each padded probe is a wasted ~23ns row gather per query. When
+    # trimming happens, full lset-indexed um_* copies are kept for
+    # host-side member evaluation (members reference lset positions);
+    # untrimmed batches reuse the up_* arrays directly (kernel fallback)
+    um = {}
+    uneed = int(lv.sum(axis=1).max(initial=0))
+    utier = next((t for t in (1, 2, 4, 8, 16, 32, 64, 128)
+                  if t >= max(uneed, 1)), lset_cap)
+    utier = min(utier, lset_cap)
+    if utier < lset_cap:
+        um = {"um_fp1": up_fp1.astype(np.uint32).view(np.int32),
+              "um_fp2": up_fp2.astype(np.uint32).view(np.int32),
+              "um_score": up_score.astype(np.int32)}
+        uorder = np.argsort(~lv, axis=1, kind="stable")[:, :utier]
+        up_slot = np.take_along_axis(up_slot, uorder, 1)
+        up_fp1 = np.take_along_axis(up_fp1, uorder, 1)
+        up_fp2 = np.take_along_axis(up_fp2, uorder, 1)
+        up_score = np.take_along_axis(up_score, uorder, 1)
+
     return {
+        **um,
         "hp_slot": hp_slot.astype(np.int32),
         "hp_fp1": hp_fp1.astype(np.uint32).view(np.int32),
         "hp_fp2": hp_fp2.astype(np.uint32).view(np.int32),
@@ -477,9 +498,18 @@ def hint_fp_match(t: dict, q: dict):
     has_uri = q["has_uri"][:, None]
     has_host = q["has_host"][:, None]
 
-    # per-candidate URI evaluation data, packed once: [B, Lc, 3]
-    q_umeta = jnp.stack([q["up_fp1"], q["up_fp2"], q["up_score"]], axis=-1)
+    # per-candidate URI evaluation data (FULL lset width — host-side
+    # members index it by lset position; um_* exist iff the up_* probe
+    # arrays were trimmed): [B, lset_cap, 3]
+    q_umeta = jnp.stack([q.get("um_fp1", q["up_fp1"]),
+                         q.get("um_fp2", q["up_fp2"]),
+                         q.get("um_score", q["up_score"])], axis=-1)
 
+    # NOTE: an equality-mask one-hot einsum select here measured ~7x
+    # faster than take_along_axis BUT miscompiles on the axon backend in
+    # some fusion contexts (step_fn diverged from the oracle while the
+    # multi-step loop and CPU stayed correct) — second sighting of the
+    # bug class after the row-packed trie select. Keep gather forms.
     def uri_side_level(lidx, uf1, uf2, ukind, shape):
         """uri_level for host-side members (kind: 0 none / 1 normal /
         2 wildcard); lidx indexes this table's lset probes."""
@@ -814,6 +844,10 @@ def compile_cidr_fp(networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
 
     trie = None
     trie_acl = None
+    if use_trie and not pats4 and not caps.get("S1"):
+        # v6-only table (and no reused-caps shape to honor): skip the
+        # all-miss trie entirely — no build, upload, or per-query walk
+        use_trie = False
     if use_trie:
         try:
             if acl is None:
@@ -1132,6 +1166,21 @@ def compile_cidr_fp_sharded(networks: Sequence, n_shards: int,
 def encode_hint_queries_fp_sharded(hints: Sequence,
                                    stab: ShardedHashTable) -> dict:
     """Per-shard probe encodings stacked on the leading shard axis
-    (salts and slot offsets are shard-local)."""
+    (salts and slot offsets are shard-local). Probe widths are
+    content-dependent (trimmed to each shard's live probes), so they
+    are re-padded to the widest shard before stacking."""
     per = [encode_hint_queries_fp(hints, t) for t in stab.shards]
+    # um_* exist iff that shard's uri probes were trimmed; shards must
+    # agree on keys (fallback = the shard's untrimmed up_* arrays)
+    if any("um_fp1" in p for p in per):
+        for p in per:
+            for mk_, pk_ in (("um_fp1", "up_fp1"), ("um_fp2", "up_fp2"),
+                             ("um_score", "up_score")):
+                p.setdefault(mk_, p[pk_])
+    for k in ("hp_slot", "hp_fp1", "hp_fp2", "hp_level",
+              "up_slot", "up_fp1", "up_fp2", "up_score"):
+        w = max(p[k].shape[1] for p in per)
+        for p in per:
+            if p[k].shape[1] < w:
+                p[k] = np.pad(p[k], ((0, 0), (0, w - p[k].shape[1])))
     return {k: np.stack([p[k] for p in per]) for k in per[0]}
