@@ -1,0 +1,66 @@
+"""OOM survival handler (app/OOMHandler.java analog)."""
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def run_child(code: str):
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=60,
+                          env={**os.environ, "PYTHONPATH": REPO,
+                               "JAX_PLATFORMS": "cpu"})
+
+
+def test_memoryerror_logs_and_exits_137():
+    r = run_child(
+        "from vproxy_tpu.utils import oom\n"
+        "oom.install()\n"
+        "raise MemoryError('simulated heap exhaustion')\n")
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    assert "out of memory" in r.stderr
+    assert "simulated heap exhaustion" in r.stderr
+
+
+def test_memoryerror_on_thread_exits_137():
+    r = run_child(
+        "import threading, time\n"
+        "from vproxy_tpu.utils import oom\n"
+        "oom.install()\n"
+        "t = threading.Thread(target=lambda: (_ for _ in ()).throw(\n"
+        "    MemoryError('worker oom')))\n"
+        "t.start(); t.join(); time.sleep(5)\n"
+        "print('should not reach here')\n")
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    assert "worker oom" in r.stderr
+    assert "should not reach here" not in r.stdout
+
+
+def test_memoryerror_in_loop_callback_exits_137():
+    """The loop's callback guard must NOT swallow MemoryError the way it
+    swallows ordinary handler errors (Java's catch(Exception) misses
+    OutOfMemoryError; Python needs the explicit re-raise)."""
+    r = run_child(
+        "import time\n"
+        "from vproxy_tpu.utils import oom\n"
+        "from vproxy_tpu.net.eventloop import SelectorEventLoop\n"
+        "oom.install()\n"
+        "lp = SelectorEventLoop('t'); lp.loop_thread()\n"
+        "lp.run_on_loop(lambda: (_ for _ in ()).throw(MemoryError('cb oom')))\n"
+        "time.sleep(5)\n"
+        "print('should not reach here')\n")
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    assert "cb oom" in r.stderr
+    assert "should not reach here" not in r.stdout
+
+
+def test_other_exceptions_pass_through():
+    r = run_child(
+        "from vproxy_tpu.utils import oom\n"
+        "oom.install()\n"
+        "raise ValueError('normal crash')\n")
+    assert r.returncode == 1
+    assert "ValueError: normal crash" in r.stderr
+    assert "out of memory" not in r.stderr

@@ -47,6 +47,11 @@ def _addr(s: str):
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
 
+    # beforeStart parity (Main.java:64-107): the OOM survival reserve
+    # comes first, covering the deployable apps below too
+    from .utils.oom import install as install_oom
+    install_oom()
+
     # deployable apps (reference -Deploy=...): first arg selects the app
     if argv and argv[0].lower() in ("simple", "helloworld", "daemon",
                                     "kcptun", "websocks"):

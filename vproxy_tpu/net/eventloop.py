@@ -31,9 +31,14 @@ MAX_EVENTS = 256
 def _guard(fn, *args) -> None:
     """Run a callback; a failing handler must never kill the loop thread
     (the reference logs and survives — Logger error paths in
-    SelectorEventLoop.doHandling)."""
+    SelectorEventLoop.doHandling). MemoryError is NOT survivable: unlike
+    Java's OutOfMemoryError (an Error, invisible to catch(Exception)),
+    it IS an Exception here and must reach the OOM handler's
+    log-then-die contract (utils/oom.py), not a limping heap."""
     try:
         fn(*args)
+    except MemoryError:
+        raise
     except Exception:
         traceback.print_exc()
 
@@ -280,6 +285,8 @@ class SelectorEventLoop:
         try:
             while not self._closed:
                 self.one_poll()
+        except MemoryError:
+            raise  # threading.excepthook -> oom._die (exit 137)
         except Exception:
             # the loop machinery itself died (callbacks are guarded —
             # this is a poll/queue bug or fd catastrophe). Mark closed so

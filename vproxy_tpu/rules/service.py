@@ -205,6 +205,8 @@ class ClassifyService:
             for kind, matcher, reqs in batches:
                 try:
                     self._dispatch(kind, matcher, reqs)
+                except MemoryError:
+                    raise  # OOM contract: log-then-die, not limp (utils/oom)
                 except Exception:
                     # the dispatcher thread must survive ANY per-batch
                     # error (incl. oracle/delivery bugs) — a dead thread
@@ -214,6 +216,8 @@ class ClassifyService:
                                "no-match to batch", exc=True)
                     try:
                         self._deliver(reqs, [-1] * len(reqs))
+                    except MemoryError:
+                        raise
                     except Exception:
                         _log.error("classify delivery failed", exc=True)
 
@@ -286,6 +290,8 @@ class ClassifyService:
                     self._note_lone_latency("device", time.monotonic() - t0)
                 self.stats.dispatches += 1
                 self.stats.device_queries += n
+            except MemoryError:
+                raise
             except Exception as e:
                 self.stats.failovers += 1
                 self._device_down_until = time.monotonic() + self.retry_s
@@ -336,6 +342,8 @@ class ClassifyService:
             def run(cb=r.cb, i=i) -> None:
                 try:
                     cb(i, payload)
+                except MemoryError:
+                    raise
                 except Exception:
                     _log.error("classify callback failed", exc=True)
 
