@@ -285,16 +285,18 @@ class SelectorEventLoop:
         try:
             while not self._closed:
                 self.one_poll()
-        except MemoryError:
-            raise  # threading.excepthook -> oom._die (exit 137)
-        except Exception:
-            # the loop machinery itself died (callbacks are guarded —
+        except Exception as e:
+            # the loop machinery itself died (callbacks are guarded* —
             # this is a poll/queue bug or fd catastrophe). Mark closed so
             # writers stop, release fds + the native loop (close() would
             # early-return on the _closed flag), then notify. Death
             # callbacks fire strictly AFTER fd cleanup so re-homing can
             # re-bind the same addresses; the graceful path fires them
             # from close() with the same ordering.
+            # (*) MemoryError is the exception: _guard re-raises it, and
+            # after the SAME cleanup (run_on_loop's "True means it WILL
+            # run" promise must not outlive the thread) it propagates to
+            # threading.excepthook — oom._die when installed (exit 137).
             import sys
             import traceback
             print(f"event loop {self.name} CRASHED:", file=sys.stderr)
@@ -304,6 +306,8 @@ class SelectorEventLoop:
             gi.deregister_loop(self)
             self._cleanup_native()
             self._fire_death()
+            if isinstance(e, MemoryError):
+                raise
 
     def loop_thread(self) -> threading.Thread:
         th = threading.Thread(target=self.loop, name=self.name, daemon=True)

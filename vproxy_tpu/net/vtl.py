@@ -1,9 +1,18 @@
-"""ctypes binding for the native host runtime (native/vtl.cpp).
+"""The FD provider seam: native host runtime by default, pure-Python
+fallback behind the same surface.
 
-Auto-builds libvtl.so on first import if missing (make in
-vproxy_tpu/native). All fd-returning calls raise OSError on negative
-return; I/O calls return -EAGAIN as the sentinel AGAIN instead of
-raising (hot path).
+Parity: the reference's `-Dvfd=provided|jdk|posix` backend selection
+(vfd/FDProvider.java:17-36). Here VPROXY_TPU_FD_PROVIDER picks:
+
+* "native" (default) — ctypes binding for native/vtl.cpp; auto-builds
+  libvtl.so on first import (make in vproxy_tpu/native).
+* "py" — net/vtl_py.py, stdlib sockets + select.epoll with a Python
+  splice pump; also the automatic fallback when the native library
+  cannot be built or loaded (no toolchain), like the reference falling
+  back to the JDK backend where the JNI library is absent.
+
+All fd-returning calls raise OSError on negative return; I/O calls
+return -EAGAIN as the sentinel AGAIN instead of raising (hot path).
 """
 from __future__ import annotations
 
@@ -67,7 +76,24 @@ def _load() -> ctypes.CDLL:
     return lib
 
 
-LIB = _load()
+PROVIDER = os.environ.get("VPROXY_TPU_FD_PROVIDER", "")
+if PROVIDER not in ("", "native", "py"):
+    raise ValueError(f"VPROXY_TPU_FD_PROVIDER={PROVIDER!r}: "
+                     "expected 'native' or 'py'")
+if PROVIDER == "py":
+    LIB = None
+elif PROVIDER == "native":
+    LIB = _load()  # explicitly requested: build/load errors fail LOUDLY
+    PROVIDER = "native"
+else:  # unset: native with automatic pure-python fallback
+    try:
+        LIB = _load()
+        PROVIDER = "native"
+    except Exception as _native_err:  # no toolchain / bad .so
+        import sys as _sys
+        print(f"# vtl: native provider unavailable ({_native_err!r}); "
+              "falling back to the pure-python provider", file=_sys.stderr)
+        LIB = None
 
 
 def check(r: int) -> int:
@@ -171,6 +197,17 @@ def sock_name(fd: int, peer: bool = False):
     port = ctypes.c_int(0)
     check(LIB.vtl_sock_name(fd, 1 if peer else 0, buf, 64, ctypes.byref(port)))
     return buf.value.decode(), port.value
+
+
+# ----------------------------------------------------- provider fallback
+
+if LIB is None:
+    from . import vtl_py as _py
+    PROVIDER = "py"
+    LIB = _py.LIB
+    for _n in _py.EXPORTS:
+        if _n != "LIB":
+            globals()[_n] = getattr(_py, _n)
 
 
 # --------------------------------------------------------------- fdtrace
