@@ -158,6 +158,59 @@ def test_tcp_mode_wrr_distribution(stack):
     assert lb.accepted == 12
 
 
+def test_session_and_connection_listing(stack):
+    """ResourceType sess/conn/ss: a live spliced session is observable
+    with its front/back addresses and byte counters."""
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+
+    # split acceptor/worker groups: the session lives on a WORKER loop,
+    # which the listing must still reach (not just the acceptor loops)
+    elg = stack["make_elg"](1)
+    elg_w = stack["make_elg"](1)
+    s1 = IdServer("S")
+    stack["servers"].append(s1)
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port, weight=1)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g)
+    lb = TcpLB("lb", elg, elg_w, "127.0.0.1", 0, ups, protocol="tcp")
+    stack["lbs"].append(lb)
+    lb.start()
+
+    app = Application.create(workers=1)
+    try:
+        app.tcp_lbs["lb"] = lb
+        c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+        c.settimeout(5)
+        assert c.recv(10) == b"S"
+        deadline = time.time() + 5
+        rows = []
+        while time.time() < deadline:
+            rows = Command.execute(app, "list-detail session in tcp-lb lb")
+            if rows:
+                break
+            time.sleep(0.02)
+        assert len(rows) == 1, rows
+        assert f"-> 127.0.0.1:{s1.port}" in rows[0]
+        assert "bytes-in" in rows[0]
+        assert Command.execute(app, "list session in tcp-lb lb") == ["1"]
+        conns = Command.execute(app, "list-detail connection in tcp-lb lb")
+        assert len(conns) == 2 and f"{lb.bind_ip}:{lb.bind_port}" in conns[0]
+        socks = Command.execute(app, "list-detail server-sock in tcp-lb lb")
+        assert socks == [f"127.0.0.1:{lb.bind_port} -> loop {elg.loops[0].name}"]
+        c.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and lb.active_sessions:
+            time.sleep(0.02)
+        assert Command.execute(app, "list session in tcp-lb lb") == ["0"]
+    finally:
+        app.tcp_lbs.pop("lb", None)
+        app.close()
+
+
 def test_http_mode_host_rule_routing(stack):
     elg = stack["make_elg"](1)
     sa, sb, sc = IdServer("GA", http=True), IdServer("GB", http=True), IdServer("GC", http=True)

@@ -266,13 +266,22 @@ class _Socks5Session(Handler):
                     # (on_closed below releases the counters)
                     bconn.close()
                     return
+                front_desc = (f"{conn.remote[0]}:{conn.remote[1]}"
+                              if conn.remote else "?")
                 ffd = conn.detach()
                 bfd = bconn.detach()
                 vtl.set_nodelay(ffd)
                 vtl.set_nodelay(bfd)
-                session.loop.pump(ffd, bfd, lb.in_buffer_size, self._done)
+                pid = session.loop.pump(ffd, bfd, lb.in_buffer_size,
+                                        self._done)
+                self._pid = pid
+                # session/connection listing + the idle-timeout sweep
+                # (the reference's tcpTimeout covers socks5 sessions too)
+                lb._watch_pump(session.loop, pid,
+                               f"{front_desc} -> {ip}:{port}")
 
             def _done(self, a2b: int, b2a: int, err: int) -> None:
+                lb._unwatch_pump(session.loop, getattr(self, "_pid", None))
                 lb.bytes_in += a2b
                 lb.bytes_out += b2a
                 if svr is not None:

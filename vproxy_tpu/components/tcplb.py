@@ -67,7 +67,10 @@ class TcpLB:
         self.active_sessions = 0
         self.bytes_in = 0
         self.bytes_out = 0
-        self._pump_watch: dict[int, dict] = {}  # id(loop) -> {pid: (total, ts)}
+        # id(loop) -> {pid: (total, ts, desc)}; loops kept by id so the
+        # session listing can marshal stat reads onto the OWNING loop
+        self._pump_watch: dict[int, dict] = {}
+        self._watch_loops: dict[int, object] = {}
         self._sweep_armed: set[int] = set()
 
     # ------------------------------------------------------------ control
@@ -177,7 +180,7 @@ class TcpLB:
             if conn is None:
                 vtl.close(cfd)
                 return
-            self._splice(loop, cfd, conn, b"")
+            self._splice(loop, cfd, conn, b"", front=f"{ip}:{port}")
         elif self.protocol == "http-splice":
             self._http_classify(loop, cfd, ip, port)
         else:
@@ -213,11 +216,14 @@ class TcpLB:
 
     # ------------------------------------------------------ idle timeout
 
-    def _watch_pump(self, loop, pid: int) -> None:
+    def _watch_pump(self, loop, pid: int, desc: str = "") -> None:
         """Track spliced-session activity; kill sessions idle > timeout_ms
-        (the reference's tcpTimeout, Config.java:20 — default 15 min)."""
+        (the reference's tcpTimeout, Config.java:20 — default 15 min).
+        `desc` ("front -> back") feeds the session/connection listing
+        resources (cmd/ResourceType sess/conn)."""
         st = self._pump_watch.setdefault(id(loop), {})
-        st[pid] = (0, loop.now)
+        self._watch_loops[id(loop)] = loop  # session listing needs the obj
+        st[pid] = (0, loop.now, desc)
         if len(st) == 1:
             self._arm_sweep(loop)
 
@@ -232,7 +238,7 @@ class TcpLB:
             if not st or not self.started:
                 self._sweep_armed.discard(id(loop))
                 return
-            for pid, (last_total, last_ts) in list(st.items()):
+            for pid, (last_total, last_ts, desc) in list(st.items()):
                 try:
                     a2b, b2a, _err = loop.pump_stat(pid)
                 except OSError:
@@ -240,7 +246,7 @@ class TcpLB:
                     continue
                 total = a2b + b2a
                 if total != last_total:
-                    st[pid] = (total, loop.now)
+                    st[pid] = (total, loop.now, desc)
                 elif (loop.now - last_ts) * 1000 >= self.timeout_ms:
                     st.pop(pid, None)
                     loop.pump_close(pid)
@@ -288,7 +294,8 @@ class TcpLB:
                             return
                         buffered = bytes(parser.buf)
                         ffd = conn.detach()
-                        lb._splice(loop, ffd, back, buffered)
+                        lb._splice(loop, ffd, back, buffered,
+                                   front=f"{ip}:{port}")
 
                     lb.backend.next_async(parse_ip(ip), hint, on_back,
                                           loop=loop)
@@ -299,7 +306,7 @@ class TcpLB:
         front.set_handler(Front())
 
     def _splice(self, loop, front_fd: int, target: Connector,
-                head: bytes) -> None:
+                head: bytes, front: str = "?") -> None:
         lb = self
         svr = target.svr
         svr.conn_count += 1
@@ -335,7 +342,8 @@ class TcpLB:
                 vtl.set_nodelay(bfd)
                 pid = loop.pump(front_fd, bfd, lb.in_buffer_size, self._done)
                 self._pid = pid
-                lb._watch_pump(loop, pid)
+                lb._watch_pump(loop, pid,
+                               f"{front} -> {target.ip}:{target.port}")
 
             def _done(self, a2b: int, b2a: int, err: int) -> None:
                 lb._unwatch_pump(loop, getattr(self, "_pid", None))

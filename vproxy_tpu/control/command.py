@@ -931,6 +931,86 @@ def _stat_target(app: Application, c: Command):
     raise CmdError(f"stats not supported on {kind}")
 
 
+def _lb_context(app: Application, c: Command):
+    if not c.contexts:
+        raise CmdError(f"{c.type} requires `in tcp-lb|socks5-server <name>`")
+    kind, alias = c.contexts[0]
+    if kind not in ("tcp-lb", "socks5-server"):
+        raise CmdError(f"{c.type} lives in tcp-lb/socks5-server")
+    return _need(_all_lbs(app), alias, kind)
+
+
+def _h_server_sock(app: Application, c: Command):
+    """Listening sockets of a frontend (ResourceType ss): one per
+    acceptor loop under REUSEPORT sharding."""
+    lb = _lb_context(app, c)
+    if c.action in ("list", "list-detail"):
+        return [f"{ss.ip}:{ss.port} -> loop {ss.loop.name}"
+                for ss in lb.server_socks]
+    raise CmdError(f"unsupported action {c.action} for server-sock")
+
+
+def _sessions_of(lb) -> list:
+    """(desc, bytes_in, bytes_out) per live spliced session. Pump state
+    is loop-confined (the lock-free native engine frees pumps on the
+    owning loop thread), so each loop's stats are read ON that loop via
+    call_sync — a direct cross-thread pump_stat would race pump_free."""
+    out = []
+    for lid, loop in list(lb._watch_loops.items()):
+        def collect(lid=lid, loop=loop):
+            rows = []
+            for pid, ent in list(lb._pump_watch.get(lid, {}).items()):
+                try:
+                    a2b, b2a, _err = loop.pump_stat(pid)
+                except OSError:
+                    continue
+                rows.append((ent[2] if len(ent) > 2 else "?", a2b, b2a))
+            return rows
+        try:
+            out.extend(loop.call_sync(collect))
+        except (OSError, RuntimeError):
+            continue  # loop died mid-listing; its sessions are gone
+    return out
+
+
+def _h_session(app: Application, c: Command):
+    """Live proxied sessions (ResourceType sess): spliced pairs with
+    their byte counters; `list` returns the count."""
+    lb = _lb_context(app, c)
+    if c.action == "list":
+        return [str(lb.active_sessions)]
+    if c.action == "list-detail":
+        rows = [f"{desc} bytes-in {a2b} bytes-out {b2a}"
+                for desc, a2b, b2a in _sessions_of(lb)]
+        other = lb.active_sessions - len(rows)
+        if other > 0:  # L7 / handshaking sessions have no pump yet
+            rows.append(f"({other} non-spliced sessions)")
+        return rows
+    raise CmdError(f"unsupported action {c.action} for session")
+
+
+def _h_connection(app: Application, c: Command):
+    """Live connections (ResourceType conn): both legs of each spliced
+    session, frontend first (the reference lists front and back
+    connections individually)."""
+    lb = _lb_context(app, c)
+    if c.action == "list":
+        return [str(2 * lb.active_sessions)]
+    if c.action == "list-detail":
+        out = []
+        sess = _sessions_of(lb)
+        for desc, a2b, b2a in sess:
+            front, _, back = desc.partition(" -> ")
+            out.append(f"{front} -> {lb.bind_ip}:{lb.bind_port} "
+                       f"bytes-in {a2b} bytes-out {b2a}")
+            out.append(f"local -> {back} bytes-in {b2a} bytes-out {a2b}")
+        other = lb.active_sessions - len(sess)
+        if other > 0:
+            out.append(f"({2 * other} connections of non-spliced sessions)")
+        return out
+    raise CmdError(f"unsupported action {c.action} for connection")
+
+
 def _h_stats(app: Application, c: Command):
     t = _stat_target(app, c)
     if c.type == "bytes-in":
@@ -1121,6 +1201,9 @@ _HANDLERS = {
     "tcp-lb": _h_tl,
     "socks5-server": _h_socks5,
     "dns-server": _h_dns,
+    "server-sock": _h_server_sock,
+    "session": _h_session,
+    "connection": _h_connection,
     "bytes-in": _h_stats,
     "bytes-out": _h_stats,
     "accepted-conn-count": _h_stats,
