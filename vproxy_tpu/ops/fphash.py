@@ -549,7 +549,15 @@ def hint_fp_match(t: dict, q: dict):
         lv = jnp.where((idx >= 0) & pg, level, 0)
         cands.append((lv.reshape(b, -1), idx.reshape(b, -1)))
 
-    # ---- ALL probe rows (host + offset uri slots) in ONE gather
+    # ---- ALL probe rows (host + offset uri slots) in ONE gather.
+    # NOTE: selecting the (unique) fp-matched entry per probe BEFORE
+    # member evaluation (argmax + take_along over the E axis) measured
+    # 9.56M matches/s — but miscompiled in the plain-jit context on the
+    # axon backend (third sighting: step_fn diverged from the oracle
+    # with the same wrong checksum as the einsum variant, while the
+    # fori_loop context and CPU stayed exact). The production engine
+    # dispatches through plain jits, so that variant is unshippable
+    # until the backend bug dies. Members of EVERY entry are evaluated.
     p_cnt = q["hp_slot"].shape[1]
     rows = t["rec"][jnp.concatenate([q["hp_slot"], q["up_slot"]], axis=1)]
     hew, uew = 2 + 4 * hM, 2 + 4 * uM
