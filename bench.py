@@ -675,6 +675,20 @@ CPU_ENV = {"BENCH_ITERS": "16", "BENCH_E2E_ITERS": "8",
            "BENCH_SVC_POLICY_QUERIES": "50"}
 
 
+_LIVE_CHILDREN: list = []  # stage subprocesses, for SIGTERM cleanup
+
+
+def _run_child(cmd, env, cwd):
+    p = subprocess.Popen(cmd, env=env, cwd=cwd, stdout=sys.stderr)
+    _LIVE_CHILDREN.append(p)
+    return p
+
+
+def _reap_child(p):
+    if p in _LIVE_CHILDREN:
+        _LIVE_CHILDREN.remove(p)
+
+
 def _run_stage(name, env_over, timeout, phase_file, cpu=False):
     """Run one measured child; returns its result dict or None.
     Children rewrite their result file after every section, so a timed-
@@ -697,8 +711,8 @@ def _run_stage(name, env_over, timeout, phase_file, cpu=False):
     env.setdefault("BENCH_CHILD_BUDGET", str(max(30.0, timeout - 15.0)))
     sys.stderr.write(f"# === stage {name} (timeout {timeout:.0f}s) ===\n")
     sys.stderr.flush()
-    p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
-                          "--child"], env=env, cwd=here, stdout=sys.stderr)
+    p = _run_child([sys.executable, os.path.abspath(__file__),
+                    "--child"], env, here)
     deadline = time.time() + timeout
     while p.poll() is None and time.time() < deadline:
         time.sleep(0.5)
@@ -716,6 +730,7 @@ def _run_stage(name, env_over, timeout, phase_file, cpu=False):
                 # D-state child stuck on the wedged tunnel: abandon it —
                 # the final JSON line must still be printed
                 sys.stderr.write(f"# stage {name}: unkillable, abandoned\n")
+    _reap_child(p)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -743,9 +758,8 @@ def _run_host_stage(timeout):
     env = cpu_subprocess_env()
     env["HOSTBENCH_RESULT_FILE"] = result_file
     sys.stderr.write(f"# === stage host (timeout {timeout:.0f}s) ===\n")
-    p = subprocess.Popen([sys.executable,
-                          os.path.join(here, "bench_host.py")],
-                         env=env, cwd=here, stdout=sys.stderr)
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py")],
+                   env, here)
     sys.stderr.flush()
     try:
         p.wait(timeout)
@@ -760,6 +774,7 @@ def _run_host_stage(timeout):
                 p.wait(10)
             except subprocess.TimeoutExpired:
                 sys.stderr.write("# stage host: unkillable, abandoned\n")
+    _reap_child(p)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -782,9 +797,8 @@ def _run_switch_stage(timeout):
     env = cpu_subprocess_env()
     env["SWBENCH_RESULT_FILE"] = result_file
     sys.stderr.write(f"# === stage switch (timeout {timeout:.0f}s) ===\n")
-    p = subprocess.Popen([sys.executable,
-                          os.path.join(here, "bench_switch.py")],
-                         env=env, cwd=here, stdout=sys.stderr)
+    p = _run_child([sys.executable, os.path.join(here, "bench_switch.py")],
+                   env, here)
     sys.stderr.flush()
     try:
         p.wait(timeout)
@@ -799,6 +813,7 @@ def _run_switch_stage(timeout):
                 p.wait(10)
             except subprocess.TimeoutExpired:
                 sys.stderr.write("# stage switch: unkillable, abandoned\n")
+    _reap_child(p)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -832,6 +847,36 @@ def orchestrate():
     if os.path.exists(phase_file):
         os.unlink(phase_file)
     budget = float(os.environ.get("BENCH_BUDGET", "900"))
+
+    # The headline JSON line must survive an external wall-clock kill:
+    # print the best result published so far on SIGTERM, kill any
+    # in-flight stage child, then exit — stages flush partial results
+    # continuously, so whatever was mid-flight still contributed what it
+    # finished. One-slot container, build-then-swap: the handler can run
+    # between any two bytecodes and must never observe a half-built dict.
+    best_box: list = [None]
+
+    def publish(res):
+        best_box[0] = dict(res)
+
+    def on_term(signum, frame):
+        res = best_box[0] or {
+            "metric": "rule-matches/sec @100k rules "
+                      "(Host+DNS hints, LPM, ACL)",
+            "value": 0.0, "unit": "matches/s", "vs_baseline": 0.0,
+            "platform": "none", "stage": "killed"}
+        res["phases"] = _read_phases(phase_file)
+        res["terminated"] = True
+        for c in list(_LIVE_CHILDREN):  # don't orphan a running stage
+            try:
+                c.terminate()
+            except OSError:
+                pass
+        print(json.dumps(res))
+        sys.stdout.flush()
+        os._exit(143)
+
+    signal.signal(signal.SIGTERM, on_term)
     smoke_timeout = min(float(os.environ.get("BENCH_SMOKE_TIMEOUT", "180")),
                         budget * 0.45)
     t_start = time.time()
@@ -846,11 +891,13 @@ def orchestrate():
     smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
     if usable(smoke) and smoke.get("platform") != "cpu":
         result = smoke
+        publish(smoke)
         remaining = budget - (time.time() - t_start) - 15
         if remaining > 90:
             full = _run_stage("tpu-full", {}, remaining, phase_file)
             if usable(full):
                 result = full
+                publish(full)
     if result is None:
         # no TPU evidence: CPU evidence-of-life run (trimmed iterations;
         # the table is NOT trimmed — the metric is @100k rules)
@@ -862,12 +909,18 @@ def orchestrate():
                   "value": 0.0, "unit": "matches/s", "vs_baseline": 0.0,
                   "platform": "none", "stage": "failed"}
     # host-path req/s (native splice pump) rides along in every run
+    publish(result)
     result.update(_run_host_stage(
         float(os.environ.get("BENCH_HOST_TIMEOUT", "120"))))
+    publish(result)
     # switch data plane (BASELINE config #4) rides along too
     result.update(_run_switch_stage(
         float(os.environ.get("BENCH_SWITCH_TIMEOUT", "240"))))
+    publish(result)
     result["phases"] = _read_phases(phase_file)
+    # complete: disarm the handler so a late SIGTERM can't emit a second
+    # (or interleaved) headline line after this one
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
     print(json.dumps(result))
     return 0
 
