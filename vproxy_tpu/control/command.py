@@ -813,15 +813,19 @@ def _h_user(app: Application, c: Command):
         except ValueError as e:
             raise CmdError(str(e))
         return "OK"
+    from ..vswitch.switch import display_user_name
     if c.action in ("list", "list-detail"):
         if c.action == "list":
-            return list(sw.users.keys())
-        return [f"{u} -> vni {vni}" for u, (_, vni, _pw) in sw.users.items()]
+            return [display_user_name(u) for u in sw.users]
+        return [f"{display_user_name(u)} -> vni {vni}"
+                for u, (_, vni, _pw) in sw.users.items()]
     if c.action in ("remove", "force-remove"):
         try:
             sw.del_user(c.alias)
         except KeyError:
             raise CmdError(f"user {c.alias!r} not found")
+        except ValueError as e:  # format-invalid alias, e.g. too short
+            raise CmdError(str(e))
         return "OK"
     raise CmdError(f"unsupported action {c.action} for user")
 

@@ -111,8 +111,10 @@ def current_config(app: Application) -> str:
                     f"via {format_ip(r.via_ip)}"
                 lines.append(f"add route {r.alias} to vpc {net.vni} "
                              f"in switch {sw.alias} network {r.rule} {tgt}")
+        from ..vswitch.switch import display_user_name
         for user, (_key, vni, password) in sw.users.items():
-            lines.append(f"add user {user} to switch {sw.alias} "
+            lines.append(f"add user {display_user_name(user)} "
+                         f"to switch {sw.alias} "
                          f"password {password} vni {vni}")
         for iface in sw.list_ifaces():
             if iface.name.startswith("remote:"):

@@ -161,6 +161,8 @@ def _parse_record(data: bytes, off: int) -> tuple[Record, int]:
     elif rtype in (CNAME, PTR, NS):
         rdata, _ = _decode_name(data, off)
     elif rtype == SRV:
+        if rdlen < 6:
+            raise DNSFormatError("truncated SRV rdata")
         prio, weight, port = struct.unpack(">HHH", raw[:6])
         target, _ = _decode_name(data, off + 6)
         rdata = (prio, weight, port, target)

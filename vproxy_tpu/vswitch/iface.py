@@ -62,10 +62,11 @@ class UserIface(Iface):
     packets; the client's vni is forced to the user's assigned vni."""
 
     def __init__(self, user: str, remote: tuple[str, int], vni: int):
-        self.user = user
+        from .switch import display_user_name  # call-time: import cycle
+        self.user = user  # wire form ('+'-padded to 8)
         self.remote = remote
         self.local_side_vni = vni
-        self.name = f"user:{user}"
+        self.name = f"user:{display_user_name(user)}"
 
     def send_vxlan(self, sw, pkt: Vxlan) -> None:
         p = VProxySwitchPacket(self.user, VPROXY_TYPE_VXLAN, pkt)
@@ -83,10 +84,11 @@ class UserClientIface(Iface):
     PING_PERIOD_MS = 20_000
 
     def __init__(self, user: str, key: bytes, remote_ip: str, remote_port: int):
-        self.user = user
+        from .switch import display_user_name  # call-time: import cycle
+        self.user = user  # wire form ('+'-padded to 8)
         self.key = key
         self.remote = (remote_ip, remote_port)
-        self.name = f"ucli:{user}"
+        self.name = f"ucli:{display_user_name(user)}"
         self._periodic = None
 
     def attach(self, sw) -> None:

@@ -461,8 +461,12 @@ class VProxySwitchPacket:
 
     def to_bytes(self, key_for) -> bytes:
         import base64
+        import binascii
         pad = self.user + "=" * (-len(self.user) % 4)
-        raw_user = base64.b64decode(pad)
+        try:
+            raw_user = base64.b64decode(pad)
+        except binascii.Error as e:
+            raise PacketError(f"user is not wire-encodable: {e}") from e
         if len(raw_user) != 6:
             raise PacketError("user must decode to 6 bytes")
         key = key_for(self.user)

@@ -33,6 +33,24 @@ _log = Logger("switch")
 IFACE_TIMEOUT_MS = 60_000  # Switch.java:630
 
 
+def format_user_name(user: str) -> str:
+    """3-8 chars [a-zA-Z0-9], padded to 8 with '+' so the name is exactly
+    8 base64 chars = the 6 raw bytes on the wire (Switch.formatUserName
+    :431-446, Consts.USER_PADDING). Without this, a short name crashes
+    the encrypted-packet encoder at SEND time with a base64 error."""
+    if not (3 <= len(user) <= 8):
+        raise ValueError("invalid user, should be at least 3 chars and "
+                         "at most 8 chars")
+    if not all(c.isascii() and c.isalnum() for c in user):
+        raise ValueError("invalid user, should only contain a-zA-Z0-9")
+    return user + "+" * (8 - len(user))
+
+
+def display_user_name(user: str) -> str:
+    """Wire form ('+'-padded to 8) back to the operator's name."""
+    return user.rstrip("+")
+
+
 def synthetic_mac(vni: int, ip: bytes) -> bytes:
     """Deterministic locally-administered mac for a synthetic ip."""
     h = hashlib.sha256(vni.to_bytes(4, "big") + ip).digest()
@@ -187,15 +205,17 @@ class Switch:
         del self.networks[vni]
 
     def add_user(self, user: str, password: str, vni: int) -> None:
-        """user: up to 8 chars [a-zA-Z0-9]; key derived from password
-        (Aes256Key: sha256 of the password bytes)."""
+        """user: 3-8 chars [a-zA-Z0-9], stored '+'-padded to 8 (the wire
+        form); key derived from password (Aes256Key: sha256 of the
+        password bytes)."""
+        user = format_user_name(user)
         if user in self.users:
-            raise ValueError(f"user {user} already exists")
+            raise ValueError(f"user {display_user_name(user)} already exists")
         key = hashlib.sha256(password.encode()).digest()
         self.users[user] = (key, vni, password)
 
     def del_user(self, user: str) -> None:
-        del self.users[user]
+        del self.users[format_user_name(user)]
 
     def key_for_user(self, user: str) -> Optional[bytes]:
         ent = self.users.get(user)
@@ -208,6 +228,7 @@ class Switch:
 
     def add_user_client(self, user: str, password: str, vni: int,
                         ip: str, port: int) -> UserClientIface:
+        user = format_user_name(user)
         key = hashlib.sha256(password.encode()).digest()
         iface = UserClientIface(user, key, ip, port)
         iface.local_side_vni = vni
