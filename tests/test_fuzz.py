@@ -15,11 +15,11 @@ from vproxy_tpu.processors.hpack import Decoder, Encoder, HpackError
 from vproxy_tpu.processors.http1 import HeadParser
 from vproxy_tpu.vswitch import packets as P
 
-rnd = random.Random(20260730)
-
-
 def corpus(valid: bytes, n=400):
-    """Random blobs + mutations/truncations of a valid message."""
+    """Random blobs + mutations/truncations of a valid message. Seeded
+    from the valid message so each test's corpus is self-contained and a
+    failure reproduces when the test runs alone."""
+    rnd = random.Random(20260730 ^ len(valid) ^ (valid[:4] or b"x")[0])
     out = []
     for _ in range(n // 2):
         out.append(bytes(rnd.getrandbits(8)
@@ -141,3 +141,68 @@ def test_fuzz_headparser_split_feeds():
     assert not whole.error and not split.error
     assert whole.method == split.method == "POST"
     assert whole.headers == split.headers
+
+
+def test_fuzz_resp_request_parser():
+    """RESP request parsing must reject garbage with CmdError (the
+    controller turns that into an -ERR reply), never anything else."""
+    from vproxy_tpu.control.command import CmdError
+    from vproxy_tpu.control.resp import _RespConn
+
+    valid = (b"*3\r\n$4\r\nAUTH\r\n$2\r\npw\r\n$4\r\nlist\r\n"
+             b"list upstream\r\n")
+
+    def parse_all(data):
+        rc = _RespConn.__new__(_RespConn)
+        rc.buf = bytearray(data)
+        for _ in range(10):  # drain a few requests
+            if rc._try_parse() is None:
+                break
+
+    for data in corpus(valid):
+        must_only_raise(parse_all, data, CmdError)
+
+
+def test_fuzz_streamed_session_frames():
+    """The stream mux must survive arbitrary frames from the transport
+    (bad sids, bad types, truncated heads) without raising."""
+    from types import SimpleNamespace
+
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.net.streamed import StreamedSession, _HEAD, F_SYN, F_PSH
+
+    lp = SelectorEventLoop("fuzz")
+    lp.loop_thread()
+    try:
+        fake = SimpleNamespace(handler=None, send=lambda d: None,
+                               close=lambda: None)
+        sess = StreamedSession(lp, fake, is_client=False,
+                               on_accept=lambda s: None)
+        valid = _HEAD.pack(1, F_SYN, 0) + _HEAD.pack(1, F_PSH, 3) + b"abc"
+        def feed(data):
+            sess.on_message(fake, data)
+        for data in corpus(valid):
+            lp.call_sync(lambda d=data: feed(d))
+    finally:
+        lp.close()
+
+
+def test_fuzz_h2_framing_and_hpack_path():
+    """The h2 frame splitter must reject garbage with H2Error (the
+    session turns that into GOAWAY), never an unrelated exception."""
+    from vproxy_tpu.processors.h2 import PREFACE, _Side, H2Error
+
+    # a valid client opening: preface + SETTINGS + HEADERS(fragment)
+    settings = (0).to_bytes(3, "big") + bytes([0x04, 0x00]) + \
+        (0).to_bytes(4, "big")
+    hdrs_payload = b"\x82\x84"  # indexed :method GET, :path /
+    headers = len(hdrs_payload).to_bytes(3, "big") + bytes([0x01, 0x05]) + \
+        (1).to_bytes(4, "big") + hdrs_payload
+    valid = PREFACE + settings + headers
+
+    def parse_all(data):
+        side = _Side(server=True, send=lambda d: None)
+        side.feed(data)
+
+    for data in corpus(valid):
+        must_only_raise(parse_all, data, H2Error)

@@ -82,7 +82,12 @@ class _RespConn(Handler):
             nl = self.buf.find(b"\r\n", pos)
             if nl < 0:
                 return None
-            ln = int(self.buf[pos + 1:nl])
+            try:
+                ln = int(self.buf[pos + 1:nl])
+            except ValueError:
+                raise CmdError("bad bulk string length")
+            if ln < 0:
+                raise CmdError("negative bulk string length")
             start = nl + 2
             if len(self.buf) < start + ln + 2:
                 return None
@@ -93,14 +98,25 @@ class _RespConn(Handler):
 
     # ------------------------------------------------------------- logic
 
+    MAX_BUF = 1 << 20  # one request; a control command never nears this
+
     def on_data(self, conn: Connection, data: bytes) -> None:
         self.buf += data
+        if len(self.buf) > self.MAX_BUF:
+            # unauthenticated clients must not balloon controller memory
+            # with a huge bulk length or an endless unterminated line
+            conn.write(enc_err("request too large"))
+            conn.close_graceful()
+            return
         while True:
             try:
                 toks = self._try_parse()
             except CmdError as e:
+                # protocol error: no resync possible mid-stream — reply
+                # then close AFTER the error flushes (a hard close drops
+                # the buffered -ERR and the peer just sees a reset)
                 conn.write(enc_err(str(e)))
-                conn.close()
+                conn.close_graceful()
                 return
             if toks is None:
                 return
