@@ -188,3 +188,44 @@ def test_pool_drops_closed_idle_conns(loop):
         time.sleep(0.02)
     assert pool.count() == 0
     assert loop.call_sync(pool.get) is None
+
+
+def test_vserver_body_limits():
+    """Garbage or huge content-length -> 400/413 + close; the inbound
+    body buffer never balloons to the declared size."""
+    import socket as sock
+
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.lib.vserver import HttpServer
+
+    lp = SelectorEventLoop("lim")
+    lp.loop_thread()
+    try:
+        srv = HttpServer(lp)
+        srv.post("/x", lambda r: r.resp.end({"ok": True}))
+        srv.listen(0)
+
+        def send_raw(payload):
+            c = sock.create_connection(("127.0.0.1", srv.port), timeout=5)
+            c.sendall(payload)
+            data = b""
+            while True:
+                d = c.recv(65536)
+                if not d:
+                    break
+                data += d
+            c.close()
+            return data
+
+        r = send_raw(b"POST /x HTTP/1.1\r\nhost: h\r\n"
+                     b"content-length: banana\r\n\r\n")
+        assert b"400 Bad Request" in r
+        r = send_raw(b"POST /x HTTP/1.1\r\nhost: h\r\n"
+                     b"content-length: 99999999999\r\n\r\n")
+        assert b"413 Payload Too Large" in r
+        r = send_raw(b"POST /x HTTP/1.1\r\nhost: h\r\ncontent-length: 2\r\n"
+                     b"connection: close\r\n\r\nhi")
+        assert b"200 OK" in r
+        srv.close(sync=True)
+    finally:
+        lp.close()

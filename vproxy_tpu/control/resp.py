@@ -82,12 +82,10 @@ class _RespConn(Handler):
             nl = self.buf.find(b"\r\n", pos)
             if nl < 0:
                 return None
-            try:
-                ln = int(self.buf[pos + 1:nl])
-            except ValueError:
+            raw_ln = bytes(self.buf[pos + 1:nl])
+            if not raw_ln.isdigit():  # strict digits: no '+5', '1_6'
                 raise CmdError("bad bulk string length")
-            if ln < 0:
-                raise CmdError("negative bulk string length")
+            ln = int(raw_ln)
             start = nl + 2
             if len(self.buf) < start + ln + 2:
                 return None
@@ -106,17 +104,17 @@ class _RespConn(Handler):
             # unauthenticated clients must not balloon controller memory
             # with a huge bulk length or an endless unterminated line
             conn.write(enc_err("request too large"))
-            conn.close_graceful()
+            conn.close_draining()
             return
         while True:
             try:
                 toks = self._try_parse()
             except CmdError as e:
-                # protocol error: no resync possible mid-stream — reply
-                # then close AFTER the error flushes (a hard close drops
-                # the buffered -ERR and the peer just sees a reset)
+                # protocol error: no resync possible mid-stream — reply,
+                # half-close, and drain (a hard close while the peer is
+                # still sending turns into a RST that eats the -ERR)
                 conn.write(enc_err(str(e)))
-                conn.close_graceful()
+                conn.close_draining()
                 return
             if toks is None:
                 return

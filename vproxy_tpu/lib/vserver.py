@@ -16,6 +16,10 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..net.eventloop import SelectorEventLoop
 from ..processors.http1 import HeadParser
 
+# inbound body cap: requests to the control surface / embedded servers
+# must not balloon memory on a huge (or garbage) content-length
+MAX_BODY = 16 * 1024 * 1024
+
 REASONS = {200: "OK", 201: "Created", 204: "No Content", 301: "Moved Permanently",
            302: "Found", 400: "Bad Request", 401: "Unauthorized",
            403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
@@ -243,7 +247,23 @@ class _HttpSrvConn(Handler):
                 # head already parsed: bytes accumulate as body
                 self.parser.buf += self.buf
                 self.buf.clear()
-            cl = int(self.parser.header("content-length") or 0)
+            cl_s = self.parser.header("content-length")
+            # strict 1*DIGIT (RFC 9110): int()'s leniency ('+16', '1_6')
+            # would disagree with a front proxy on framing
+            if cl_s is None:
+                cl = 0
+            elif cl_s.isascii() and cl_s.isdigit():
+                cl = int(cl_s)
+            else:
+                cl = -1
+            if cl < 0 or cl > MAX_BODY:
+                code = (b"400 Bad Request" if cl < 0
+                        else b"413 Payload Too Large")
+                self.conn.write(b"HTTP/1.1 " + code +
+                                b"\r\ncontent-length: 0\r\n"
+                                b"connection: close\r\n\r\n")
+                self.conn.close_draining()
+                return
             have = len(self.parser.buf) - self.parser.head_len
             if have < cl:
                 return

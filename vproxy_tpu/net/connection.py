@@ -51,6 +51,7 @@ class Connection:
         self.bytes_out = 0
         self._connecting = connecting
         self._closing = False
+        self._shut_wr_pending = False
         self._interest = 0
         loop.add(fd, 0, self._on_event)
         self._want(vtl.EV_WRITE if connecting else vtl.EV_READ)
@@ -105,6 +106,31 @@ class Connection:
             return
         self._closing = True
         self.pause_reading()
+
+    def close_draining(self, grace_ms: int = 1000) -> None:
+        """Early-response teardown: flush the response, HALF-close the
+        write side, and keep discarding inbound bytes for up to grace_ms.
+        Closing while the peer is still streaming (e.g. a rejected
+        oversized body) leaves unread bytes in the kernel buffer and the
+        close turns into a RST that can destroy the delivered response;
+        draining lets the peer actually see the 413/-ERR."""
+        if self.closed or self.detached:
+            return
+
+        class _Discard(Handler):
+            def on_data(self, conn: "Connection", data: bytes) -> None: ...
+
+            def on_eof(self, conn: "Connection") -> None:
+                conn.close()
+
+        self.set_handler(_Discard())
+        self._want(self._interest | vtl.EV_READ)
+        if self.out:
+            self._shut_wr_pending = True
+            self._want(self._interest | vtl.EV_WRITE)
+        else:
+            vtl.shutdown_wr(self.fd)
+        self.loop.delay(grace_ms, self.close)
 
     def detach(self) -> int:
         """Unregister and return the raw fd (for pump handover / transfer)."""
@@ -183,6 +209,9 @@ class Connection:
                 if self._closing:
                     self.close()
                     return
+                if self._shut_wr_pending:
+                    self._shut_wr_pending = False
+                    vtl.shutdown_wr(self.fd)
                 self._want(self._interest & ~vtl.EV_WRITE)
                 self.handler.on_drained(self)
 
