@@ -1,0 +1,169 @@
+"""Whole-system boot: ONE daemon process hosting tcp-lb + socks5 + dns +
+switch + controllers from a config file, serving mixed traffic, then a
+clean SIGTERM shutdown that saves config (CI.java's boot-the-real-app
+pattern: drive it exactly like an operator)."""
+import json
+import os
+import pathlib
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+from test_tcplb import IdServer
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def _recv_all(c):
+    data = b""
+    while True:
+        try:
+            d = c.recv(65536)
+        except OSError:
+            break
+        if not d:
+            break
+        data += d
+    return data
+
+
+def test_full_daemon_boot_mixed_traffic(tmp_path):
+    backend = IdServer("BOOT", http=True)
+    cfg = tmp_path / "boot.cfg"
+    cfg.write_text("\n".join([
+        "add upstream u0",
+        "add server-group g0 timeout 500 period 200 up 1 down 3 protocol none",
+        f"add server s0 to server-group g0 address 127.0.0.1:{backend.port} "
+        "weight 10",
+        'add server-group g0 to upstream u0 weight 10 '
+        'annotations {"vproxy/hint-host":"svc.example.com"}',
+        "add tcp-lb lb0 address 127.0.0.1:0 upstream u0 protocol tcp",
+        "add socks5-server s50 address 127.0.0.1:0 upstream u0",
+        "add dns-server d0 address 127.0.0.1:0 upstream u0",
+        "add switch sw0 address 127.0.0.1:0",
+        "add vpc 3 to switch sw0 v4network 10.3.0.0/16",
+    ]) + "\n")
+    home = tmp_path / "home"
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "VPROXY_TPU_HOME": str(home), "VPROXY_TPU_WORKERS": "2"}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "vproxy_tpu",
+         "resp-controller", "127.0.0.1:0", "pw",
+         "http-controller", "127.0.0.1:0",
+         "load", str(cfg), "noStdIOController"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        import select
+
+        resp_port = http_port = None
+        deadline = time.time() + 60
+        buf = ""
+        while time.time() < deadline and (resp_port is None
+                                          or http_port is None):
+            # select-bounded reads: a silent daemon must FAIL the test
+            # at the deadline, not hang it in readline()
+            r, _, _ = select.select([p.stdout], [], [], 0.5)
+            if not r:
+                continue
+            chunk = os.read(p.stdout.fileno(), 4096).decode()
+            if not chunk:
+                break
+            buf += chunk
+            for line in buf.splitlines():
+                if line.startswith("resp-controller on "):
+                    resp_port = int(line.rsplit(":", 1)[1])
+                elif line.startswith("http-controller on "):
+                    http_port = int(line.rsplit(":", 1)[1])
+        assert resp_port and http_port
+
+        # find the data-plane ports through the typed REST surface
+        import urllib.request
+
+        def rest(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}{path}", timeout=5) as r:
+                return json.loads(r.read())
+
+        deadline = time.time() + 30
+        lb_port = s5_port = dns_port = None
+        while time.time() < deadline and not (lb_port and s5_port):
+            lbs = rest("/api/v1/module/tcp-lb")
+            s5s = rest("/api/v1/module/socks5-server")
+            dnss = rest("/api/v1/module/dns-server")
+            if lbs and s5s and dnss:
+                lb_port = int(lbs[0]["address"].rsplit(":", 1)[1])
+                s5_port = int(s5s[0]["address"].rsplit(":", 1)[1])
+                dns_port = int(dnss[0]["address"].rsplit(":", 1)[1])
+            time.sleep(0.1)
+        assert lb_port and s5_port and dns_port
+
+        # 1) tcp-lb splice (wait for the health check to mark the
+        # backend up; until then the LB refuses)
+        deadline = time.time() + 15
+        body = b""
+        while time.time() < deadline and b"BOOT" not in body:
+            c = socket.create_connection(("127.0.0.1", lb_port), timeout=5)
+            c.settimeout(5)
+            c.sendall(b"GET / HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+            body = _recv_all(c)
+            c.close()
+            if b"BOOT" not in body:
+                time.sleep(0.2)
+        assert b"BOOT" in body
+
+        # 2) socks5 by domain
+        c = socket.create_connection(("127.0.0.1", s5_port), timeout=5)
+        c.settimeout(5)
+        c.sendall(b"\x05\x01\x00")
+        assert c.recv(2) == b"\x05\x00"
+        c.sendall(b"\x05\x01\x00\x03" + bytes([15]) + b"svc.example.com" +
+                  struct.pack(">H", 80))
+        assert c.recv(10)[:2] == b"\x05\x00"
+        c.sendall(b"GET / HTTP/1.1\r\nhost: x\r\nconnection: close\r\n\r\n")
+        assert b"BOOT" in _recv_all(c)
+        c.close()
+
+        # 3) dns query for the hint domain answers with the backend
+        from vproxy_tpu.dns import packet as dnsp
+        q = dnsp.Packet(id=9, questions=[dnsp.Question("svc.example.com.",
+                                                       dnsp.A)])
+        u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        u.settimeout(5)
+        u.sendto(q.encode(), ("127.0.0.1", dns_port))
+        resp = dnsp.parse(u.recvfrom(4096)[0])
+        u.close()
+        assert resp.answers and resp.answers[0].rdata == \
+            socket.inet_aton("127.0.0.1")
+
+        # 4) control mutation over RESP while traffic flows
+        c = socket.create_connection(("127.0.0.1", resp_port), timeout=5)
+        c.settimeout(5)
+
+        def cmd(*args):
+            out = b"*%d\r\n" % len(args)
+            for a in args:
+                b = str(a).encode()
+                out += b"$%d\r\n%s\r\n" % (len(b), b)
+            c.sendall(out)
+            return c.recv(65536)
+
+        assert b"+OK" in cmd("AUTH", "pw")
+        assert b"lb0" in cmd("list", "tcp-lb")
+        assert b"+OK" in cmd("add", "upstream", "u9")
+        c.close()
+
+        # 5) SIGTERM: graceful save + clean exit
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(30) == 0
+        saved = (home / "vproxy.last").read_text()
+        assert "add tcp-lb lb0" in saved
+        assert "add upstream u9" in saved  # the live mutation persisted
+        assert "add vpc 3 to switch sw0" in saved
+    finally:
+        if p.poll() is None:
+            p.kill()
+        backend.close()

@@ -206,3 +206,72 @@ def test_fuzz_h2_framing_and_hpack_path():
 
     for data in corpus(valid):
         must_only_raise(parse_all, data, H2Error)
+
+
+def test_fuzz_dhcp_reply_parser():
+    from vproxy_tpu.dns import dhcp
+
+    valid_head = (b"\x02" + b"\x01\x06\x00" + (0x1234).to_bytes(4, "big") +
+                  b"\x00" * (2 + 2 + 16 + 16 + 64 + 128))
+    valid = valid_head + b"\x63\x82\x53\x63" + \
+        bytes([53, 1, 2, 6, 4, 8, 8, 8, 8, 255])
+    for data in corpus(valid):
+        dhcp.parse_reply(data, 0x1234)  # None or a list; never raises
+
+
+def test_fuzz_socks5_live_handshake():
+    """Garbage handshakes against a LIVE socks5 server: each connection
+    may be rejected/closed, but the server must keep serving — a valid
+    handshake afterwards still works."""
+    import socket as sock
+    import struct
+
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.socks5 import Socks5Server
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.rules.ir import HintRule
+
+    from test_tcplb import IdServer, fast_hc, wait_healthy
+
+    elg = EventLoopGroup("s5f", 1)
+    backend = IdServer("FZ")
+    g = ServerGroup("g", elg, fast_hc())
+    g.add("a", "127.0.0.1", backend.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u")
+    ups.add(g, annotations=HintRule(host="svc.example.com"))
+    srv = Socks5Server("s5f", elg, elg, "127.0.0.1", 0, ups)
+    srv.start()
+    try:
+        valid = (b"\x05\x01\x00" + b"\x05\x01\x00\x03" +
+                 bytes([len("svc.example.com")]) + b"svc.example.com" +
+                 struct.pack(">H", 80))
+        for data in corpus(valid, n=60):
+            c = sock.create_connection(("127.0.0.1", srv.bind_port),
+                                       timeout=5)
+            c.settimeout(0.4)
+            try:
+                c.sendall(data)
+                while c.recv(4096):
+                    pass
+            except OSError:
+                pass
+            finally:
+                c.close()
+        # the server survived: a correct handshake still completes
+        c = sock.create_connection(("127.0.0.1", srv.bind_port), timeout=5)
+        c.settimeout(5)
+        c.sendall(b"\x05\x01\x00")
+        assert c.recv(2) == b"\x05\x00"
+        c.sendall(b"\x05\x01\x00\x03" + bytes([15]) + b"svc.example.com" +
+                  struct.pack(">H", 80))
+        rep = c.recv(10)
+        assert rep[:2] == b"\x05\x00"
+        assert c.recv(10) == b"FZ"  # IdServer banner through the tunnel
+        c.close()
+    finally:
+        srv.stop()
+        g.close()
+        backend.close()
+        elg.close()

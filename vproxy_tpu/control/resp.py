@@ -68,10 +68,10 @@ class _RespConn(Handler):
         nl = self.buf.find(b"\r\n", pos)
         if nl < 0:
             return None
-        try:
-            n = int(self.buf[1:nl])
-        except ValueError:
+        raw_n = bytes(self.buf[1:nl])
+        if not raw_n.isdigit():  # same strictness as the bulk lengths
             raise CmdError("bad RESP array header")
+        n = int(raw_n)
         pos = nl + 2
         items = []
         for _ in range(n):

@@ -239,7 +239,8 @@ class _HttpSrvConn(Handler):
                     self.conn.write(
                         b"HTTP/1.1 400 Bad Request\r\n"
                         b"content-length: 0\r\nconnection: close\r\n\r\n")
-                    self.conn.close_graceful()
+                    # peer may still be streaming: drain, don't RST
+                    self.conn.close_draining()
                     return
                 if not self.parser.done:
                     return
@@ -247,13 +248,18 @@ class _HttpSrvConn(Handler):
                 # head already parsed: bytes accumulate as body
                 self.parser.buf += self.buf
                 self.buf.clear()
-            cl_s = self.parser.header("content-length")
-            # strict 1*DIGIT (RFC 9110): int()'s leniency ('+16', '1_6')
-            # would disagree with a front proxy on framing
-            if cl_s is None:
+            # strict 1*DIGIT and NO disagreeing duplicates (RFC 9110):
+            # int()'s leniency ('+16', '1_6') or picking one of two
+            # different content-lengths would disagree with a front
+            # proxy on framing — a request-smuggling vector
+            cls_ = {v for k, v in self.parser.headers
+                    if k == "content-length"}
+            if not cls_:
                 cl = 0
-            elif cl_s.isascii() and cl_s.isdigit():
-                cl = int(cl_s)
+            elif len(cls_) == 1:
+                cl_s = next(iter(cls_))
+                cl = (int(cl_s) if cl_s.isascii() and cl_s.isdigit()
+                      else -1)
             else:
                 cl = -1
             if cl < 0 or cl > MAX_BODY:

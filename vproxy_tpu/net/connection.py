@@ -33,6 +33,18 @@ class Handler:
         """out buffer fully flushed."""
 
 
+class _DrainHandler(Handler):
+    """close_draining's discard mode: inbound bytes are dropped, EOF
+    closes, but close notification still reaches the ORIGINAL handler —
+    owners (e.g. HttpServer._conns) must not leak rejected sessions."""
+
+    def __init__(self, prev: Handler):
+        self._prev = prev
+
+    def on_closed(self, conn: "Connection", err: int) -> None:
+        self._prev.on_closed(conn, err)
+
+
 class Connection:
     MAX_OUT = 4 * 1024 * 1024
 
@@ -116,14 +128,7 @@ class Connection:
         draining lets the peer actually see the 413/-ERR."""
         if self.closed or self.detached:
             return
-
-        class _Discard(Handler):
-            def on_data(self, conn: "Connection", data: bytes) -> None: ...
-
-            def on_eof(self, conn: "Connection") -> None:
-                conn.close()
-
-        self.set_handler(_Discard())
+        self.set_handler(_DrainHandler(self.handler))
         self._want(self._interest | vtl.EV_READ)
         if self.out:
             self._shut_wr_pending = True
