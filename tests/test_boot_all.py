@@ -73,7 +73,8 @@ def test_full_daemon_boot_mixed_traffic(tmp_path):
             if not chunk:
                 break
             buf += chunk
-            for line in buf.splitlines():
+            *lines, buf = buf.split("\n")  # keep the partial tail line
+            for line in lines:
                 if line.startswith("resp-controller on "):
                     resp_port = int(line.rsplit(":", 1)[1])
                 elif line.startswith("http-controller on "):

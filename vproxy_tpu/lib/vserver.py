@@ -248,6 +248,15 @@ class _HttpSrvConn(Handler):
                 # head already parsed: bytes accumulate as body
                 self.parser.buf += self.buf
                 self.buf.clear()
+            # chunked is unsupported here: a request bearing
+            # transfer-encoding would be framed as length-0 and its body
+            # parsed as the NEXT request (TE.CL desync) — reject it
+            if any(k == "transfer-encoding" for k, _ in self.parser.headers):
+                self.conn.write(b"HTTP/1.1 501 Not Implemented\r\n"
+                                b"content-length: 0\r\n"
+                                b"connection: close\r\n\r\n")
+                self.conn.close_draining()
+                return
             # strict 1*DIGIT and NO disagreeing duplicates (RFC 9110):
             # int()'s leniency ('+16', '1_6') or picking one of two
             # different content-lengths would disagree with a front

@@ -93,14 +93,21 @@ class SyntheticIpHolder:
     """Virtual IPs owned by the switch inside this VPC (each with its own
     mac): ARP/NDP answered, ICMP echo answered, routed gateways."""
 
+    _MISS = object()
+
     def __init__(self):
         self._ips: dict[bytes, bytes] = {}  # ip -> mac
+        # first_in runs once per ROUTED PACKET (gateway source pick);
+        # memoized per network, invalidated on any mutation
+        self._first_cache: dict = {}
 
     def add(self, ip: bytes, mac: bytes) -> None:
         self._ips[ip] = mac
+        self._first_cache.clear()
 
     def remove(self, ip: bytes) -> None:
         self._ips.pop(ip, None)
+        self._first_cache.clear()
 
     def lookup_mac(self, ip: bytes) -> Optional[bytes]:
         return self._ips.get(ip)
@@ -113,10 +120,16 @@ class SyntheticIpHolder:
 
     def first_in(self, net: Network) -> Optional[tuple[bytes, bytes]]:
         """-> (ip, mac) of a synthetic ip inside net (gateway source pick)."""
+        hit = self._first_cache.get(net, self._MISS)
+        if hit is not self._MISS:
+            return hit
+        found = None
         for ip, mac in self._ips.items():
             if net.contains_ip(ip):
-                return ip, mac
-        return None
+                found = (ip, mac)
+                break
+        self._first_cache[net] = found
+        return found
 
     def ips(self) -> dict[bytes, bytes]:
         return dict(self._ips)

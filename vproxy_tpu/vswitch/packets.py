@@ -65,7 +65,13 @@ def _pseudo_v6(src: bytes, dst: bytes, proto: int, length: int) -> bytes:
 
 
 class Ethernet:
-    __slots__ = ("dst", "src", "ether_type", "payload", "packet")
+    """Inner layers parse LAZILY: the switch forwards most frames
+    without ever looking past the header it needs, and to_bytes passes
+    untouched payloads through as the original bytes — the router fast
+    path (no re-serialization, no checksum recompute)."""
+
+    __slots__ = ("dst", "src", "ether_type", "payload", "_pkt",
+                 "_pkt_parsed")
 
     def __init__(self, dst: bytes, src: bytes, ether_type: int, payload,
                  packet=None):
@@ -73,29 +79,39 @@ class Ethernet:
         self.src = src
         self.ether_type = ether_type
         self.payload = payload  # bytes
-        self.packet = packet    # parsed upper packet or None
+        self._pkt = packet      # parsed upper packet or None
+        # builders that pass an object are "parsed"; parse() defers
+        self._pkt_parsed = packet is not None or not payload
+
+    @property
+    def packet(self):
+        if not self._pkt_parsed:
+            self._pkt_parsed = True
+            try:
+                if self.ether_type == ETHER_TYPE_ARP:
+                    self._pkt = Arp.parse(self.payload)
+                elif self.ether_type == ETHER_TYPE_IPV4:
+                    self._pkt = Ipv4.parse(self.payload)
+                elif self.ether_type == ETHER_TYPE_IPV6:
+                    self._pkt = Ipv6.parse(self.payload)
+            except PacketError:
+                self._pkt = None
+        return self._pkt
+
+    @packet.setter
+    def packet(self, v) -> None:
+        self._pkt = v
+        self._pkt_parsed = True
 
     @classmethod
     def parse(cls, data: bytes) -> "Ethernet":
         if len(data) < 14:
             raise PacketError("ethernet too short")
-        dst, src = data[:6], data[6:12]
-        et = struct.unpack(">H", data[12:14])[0]
-        payload = data[14:]
-        pkt = None
-        try:
-            if et == ETHER_TYPE_ARP:
-                pkt = Arp.parse(payload)
-            elif et == ETHER_TYPE_IPV4:
-                pkt = Ipv4.parse(payload)
-            elif et == ETHER_TYPE_IPV6:
-                pkt = Ipv6.parse(payload)
-        except PacketError:
-            pkt = None
-        return cls(dst, src, et, payload, pkt)
+        return cls(data[:6], data[6:12],
+                   struct.unpack(">H", data[12:14])[0], data[14:])
 
     def to_bytes(self) -> bytes:
-        body = self.packet.to_bytes() if self.packet is not None else self.payload
+        body = self._pkt.to_bytes() if self._pkt is not None else self.payload
         return self.dst + self.src + struct.pack(">H", self.ether_type) + body
 
 
@@ -125,7 +141,7 @@ class Arp:
 
 class Ipv4:
     __slots__ = ("tos", "ident", "flags_frag", "ttl", "proto", "src", "dst",
-                 "options", "payload", "packet")
+                 "options", "payload", "_pkt", "_pkt_parsed")
 
     def __init__(self, src: bytes, dst: bytes, proto: int, payload,
                  ttl: int = 64, tos: int = 0, ident: int = 0,
@@ -139,7 +155,30 @@ class Ipv4:
         self.ident = ident
         self.flags_frag = flags_frag
         self.options = options
-        self.packet = packet
+        self._pkt = packet
+        self._pkt_parsed = packet is not None or not payload
+
+    @property
+    def packet(self):
+        """Transport layer, parsed LAZILY: a routed packet never pays
+        the ICMP/TCP/UDP parse (or the re-serialization on egress)."""
+        if not self._pkt_parsed:
+            self._pkt_parsed = True
+            try:
+                if self.proto == PROTO_ICMP:
+                    self._pkt = Icmp.parse(self.payload)
+                elif self.proto == PROTO_TCP:
+                    self._pkt = Tcp.parse(self.payload)
+                elif self.proto == PROTO_UDP:
+                    self._pkt = Udp.parse(self.payload)
+            except PacketError:
+                self._pkt = None
+        return self._pkt
+
+    @packet.setter
+    def packet(self, v) -> None:
+        self._pkt = v
+        self._pkt_parsed = True
 
     @classmethod
     def parse(cls, data: bytes) -> "Ipv4":
@@ -157,25 +196,12 @@ class Ipv4:
             raise PacketError("bad total length")
         ident, flags_frag = struct.unpack(">HH", data[4:8])
         ttl, proto = data[8], data[9]
-        src, dst = data[12:16], data[16:20]
-        options = data[20:ihl]
-        payload = data[ihl:total]
-        pkt = None
-        try:
-            if proto == PROTO_ICMP:
-                pkt = Icmp.parse(payload)
-            elif proto == PROTO_TCP:
-                pkt = Tcp.parse(payload)
-            elif proto == PROTO_UDP:
-                pkt = Udp.parse(payload)
-        except PacketError:
-            pkt = None
-        return cls(src, dst, proto, payload, ttl, tos, ident, flags_frag,
-                   options, pkt)
+        return cls(data[12:16], data[16:20], proto, data[ihl:total], ttl,
+                   tos, ident, flags_frag, data[20:ihl])
 
     def to_bytes(self) -> bytes:
-        body = self.payload if self.packet is None else \
-            self.packet.to_bytes(self.src, self.dst, v6=False)
+        body = self.payload if self._pkt is None else \
+            self._pkt.to_bytes(self.src, self.dst, v6=False)
         ihl = 20 + len(self.options)
         total = ihl + len(body)
         head = bytearray(struct.pack(
@@ -192,7 +218,7 @@ class Ipv4:
 
 class Ipv6:
     __slots__ = ("src", "dst", "next_header", "hop_limit", "payload",
-                 "packet", "flow")
+                 "_pkt", "_pkt_parsed", "flow")
 
     def __init__(self, src: bytes, dst: bytes, next_header: int, payload,
                  hop_limit: int = 64, flow: int = 0, packet=None):
@@ -202,7 +228,28 @@ class Ipv6:
         self.payload = payload
         self.hop_limit = hop_limit
         self.flow = flow
-        self.packet = packet
+        self._pkt = packet
+        self._pkt_parsed = packet is not None or not payload
+
+    @property
+    def packet(self):
+        if not self._pkt_parsed:
+            self._pkt_parsed = True
+            try:
+                if self.next_header == PROTO_ICMPV6:
+                    self._pkt = Icmpv6.parse(self.payload)
+                elif self.next_header == PROTO_TCP:
+                    self._pkt = Tcp.parse(self.payload)
+                elif self.next_header == PROTO_UDP:
+                    self._pkt = Udp.parse(self.payload)
+            except PacketError:
+                self._pkt = None
+        return self._pkt
+
+    @packet.setter
+    def packet(self, v) -> None:
+        self._pkt = v
+        self._pkt_parsed = True
 
     @classmethod
     def parse(cls, data: bytes) -> "Ipv6":
@@ -212,25 +259,14 @@ class Ipv6:
         if first >> 28 != 6:
             raise PacketError("not ipv6")
         plen, nh, hl = struct.unpack(">HBB", data[4:8])
-        src, dst = data[8:24], data[24:40]
         if len(data) < 40 + plen:
             raise PacketError("short payload")
-        payload = data[40:40 + plen]
-        pkt = None
-        try:
-            if nh == PROTO_ICMPV6:
-                pkt = Icmpv6.parse(payload)
-            elif nh == PROTO_TCP:
-                pkt = Tcp.parse(payload)
-            elif nh == PROTO_UDP:
-                pkt = Udp.parse(payload)
-        except PacketError:
-            pkt = None
-        return cls(src, dst, nh, payload, hl, first & 0x0FFFFFFF, pkt)
+        return cls(data[8:24], data[24:40], nh, data[40:40 + plen], hl,
+                   first & 0x0FFFFFFF)
 
     def to_bytes(self) -> bytes:
-        body = self.payload if self.packet is None else \
-            self.packet.to_bytes(self.src, self.dst, v6=True)
+        body = self.payload if self._pkt is None else \
+            self._pkt.to_bytes(self.src, self.dst, v6=True)
         return struct.pack(">IHBB", (6 << 28) | self.flow, len(body),
                            self.next_header, self.hop_limit) + \
             self.src + self.dst + body
