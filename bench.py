@@ -877,7 +877,7 @@ def orchestrate():
         os._exit(143)
 
     signal.signal(signal.SIGTERM, on_term)
-    smoke_timeout = min(float(os.environ.get("BENCH_SMOKE_TIMEOUT", "180")),
+    smoke_timeout = min(float(os.environ.get("BENCH_SMOKE_TIMEOUT", "240")),
                         budget * 0.45)
     t_start = time.time()
 
@@ -889,6 +889,14 @@ def orchestrate():
 
     result = None
     smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
+    if not (usable(smoke) and smoke.get("platform") != "cpu") and \
+            budget - (time.time() - t_start) > smoke_timeout + 120:
+        # tunnel wedges are transient (a dying previous claimant blocks
+        # the claim): one retry before surrendering the TPU headline
+        sys.stderr.write("# tpu-smoke failed; retrying once (tunnel "
+                         "claims are transient)\n")
+        time.sleep(20)  # let a dying claimant release
+        smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
     if usable(smoke) and smoke.get("platform") != "cpu":
         result = smoke
         publish(smoke)

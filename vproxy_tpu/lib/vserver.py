@@ -251,7 +251,7 @@ class _HttpSrvConn(Handler):
             # chunked is unsupported here: a request bearing
             # transfer-encoding would be framed as length-0 and its body
             # parsed as the NEXT request (TE.CL desync) — reject it
-            if any(k == "transfer-encoding" for k, _ in self.parser.headers):
+            if self.parser.header("transfer-encoding") is not None:
                 self.conn.write(b"HTTP/1.1 501 Not Implemented\r\n"
                                 b"content-length: 0\r\n"
                                 b"connection: close\r\n\r\n")
