@@ -98,25 +98,40 @@ class SyntheticIpHolder:
     def __init__(self):
         self._ips: dict[bytes, bytes] = {}  # ip -> mac
         # first_in runs once per ROUTED PACKET (gateway source pick);
-        # memoized per network, invalidated on any mutation
+        # memoized per network, invalidated on any mutation. _by_mac is
+        # the reverse index for find_by_mac (runs per L2-forwarded
+        # packet): mac -> FIRST ip added with it, matching the old
+        # insertion-order scan
         self._first_cache: dict = {}
+        self._by_mac: dict[bytes, bytes] = {}
 
     def add(self, ip: bytes, mac: bytes) -> None:
+        old = self._ips.get(ip)
+        if old is not None and old != mac:
+            self._unindex_mac(ip, old)  # re-add with a new mac
         self._ips[ip] = mac
+        self._by_mac.setdefault(mac, ip)
         self._first_cache.clear()
 
     def remove(self, ip: bytes) -> None:
-        self._ips.pop(ip, None)
+        mac = self._ips.pop(ip, None)
+        if mac is not None:
+            self._unindex_mac(ip, mac)
         self._first_cache.clear()
+
+    def _unindex_mac(self, ip: bytes, mac: bytes) -> None:
+        if self._by_mac.get(mac) == ip:
+            del self._by_mac[mac]
+            for ip2, m2 in self._ips.items():  # next-oldest takes over
+                if m2 == mac and ip2 != ip:
+                    self._by_mac[mac] = ip2
+                    break
 
     def lookup_mac(self, ip: bytes) -> Optional[bytes]:
         return self._ips.get(ip)
 
     def find_by_mac(self, mac: bytes) -> Optional[bytes]:
-        for ip, m in self._ips.items():
-            if m == mac:
-                return ip
-        return None
+        return self._by_mac.get(mac)
 
     def first_in(self, net: Network) -> Optional[tuple[bytes, bytes]]:
         """-> (ip, mac) of a synthetic ip inside net (gateway source pick)."""
