@@ -229,3 +229,45 @@ def test_vserver_body_limits():
         srv.close(sync=True)
     finally:
         lp.close()
+
+
+def test_vserver_rejection_survives_midstream_client():
+    """A 413 issued while the client is STILL STREAMING its body must
+    reach the client (drain-then-close), not be destroyed by a RST."""
+    import socket as sock
+    import time as time_
+
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.lib.vserver import HttpServer
+
+    lp = SelectorEventLoop("drain")
+    lp.loop_thread()
+    try:
+        srv = HttpServer(lp)
+        srv.post("/x", lambda r: r.resp.end({"ok": True}))
+        srv.listen(0)
+        c = sock.create_connection(("127.0.0.1", srv.port), timeout=5)
+        c.sendall(b"POST /x HTTP/1.1\r\nhost: h\r\n"
+                  b"content-length: 99999999999\r\n\r\n")
+        # keep streaming the body while the server rejects
+        for _ in range(20):
+            try:
+                c.sendall(b"B" * 65536)
+            except OSError:
+                break
+            time_.sleep(0.005)
+        data = b""
+        c.settimeout(5)
+        while True:
+            try:
+                d = c.recv(65536)
+            except OSError:
+                break
+            if not d:
+                break
+            data += d
+        c.close()
+        assert b"413 Payload Too Large" in data
+        srv.close(sync=True)
+    finally:
+        lp.close()
