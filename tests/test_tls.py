@@ -163,5 +163,29 @@ def test_tls_command_grammar(stack, certs, tmp_path):
         assert f"add cert-key ck0 cert {cert} key {key}" in cfg
         assert "cert-key ck0" in [ln for ln in cfg.splitlines()
                                   if ln.startswith("add tcp-lb")][0]
+
+        # hot update: swap the cert at runtime (TcpLB.java:294-320
+        # "modifiable when running") — new accepts are SERVED the new
+        # cert (compare the DER the client actually received)
+        import ssl as _ssl
+        _, old_der = _tls_get(app.tcp_lbs["lb0"].bind_port,
+                              "a.example.com", "x")
+        wcert, wkey = certs["w"]
+        w_der = _ssl.PEM_cert_to_DER_cert(open(wcert).read())
+        assert old_der != w_der
+        Command.execute(app, f"add cert-key ckw cert {wcert} key {wkey}")
+        assert Command.execute(
+            app, "update tcp-lb lb0 timeout 60000 cert-key ckw") == "OK"
+        assert app.tcp_lbs["lb0"].timeout_ms == 60000
+        body, new_der = _tls_get(app.tcp_lbs["lb0"].bind_port,
+                                 "x.w.example.com", "x")
+        assert body == b"CA"
+        assert new_der == w_der  # the swapped cert is what gets served
+        # BOTH hot-set values survive the config round trip
+        cfg2 = persist.current_config(app)
+        lb_line = [ln for ln in cfg2.splitlines()
+                   if ln.startswith("add tcp-lb")][0]
+        assert "timeout 60000" in lb_line
+        assert "cert-key ckw" in lb_line
     finally:
         app.close()

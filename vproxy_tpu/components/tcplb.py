@@ -45,18 +45,15 @@ class TcpLB:
             raise ValueError(f"unsupported protocol {protocol}")
         self.holder = None
         self.cert_keys = cert_keys or []
+        self.protocol = protocol
         if cert_keys:
-            from .certkey import CertKeyHolder
-            proc = processors.get(protocol)
-            alpn = list(proc.alpn) if proc is not None and proc.alpn else None
-            self.holder = CertKeyHolder(cert_keys, alpn=alpn)
+            self.set_cert_keys(cert_keys)
         self.alias = alias
         self.acceptor = acceptor
         self.worker = worker
         self.bind_ip = bind_ip
         self.bind_port = bind_port
         self.backend = backend
-        self.protocol = protocol
         self.security_group = security_group or SecurityGroup.allow_all()
         self.in_buffer_size = in_buffer_size
         self.timeout_ms = timeout_ms
@@ -72,6 +69,7 @@ class TcpLB:
         self._pump_watch: dict[int, dict] = {}
         self._watch_loops: dict[int, object] = {}
         self._sweep_armed: set[int] = set()
+        self._sweep_timers: dict[int, object] = {}  # id(loop) -> TimerEvent
 
     # ------------------------------------------------------------ control
 
@@ -216,6 +214,35 @@ class TcpLB:
 
     # ------------------------------------------------------ idle timeout
 
+    # ------------------------------------------------- hot-settable knobs
+
+    def set_cert_keys(self, cert_keys: list) -> None:
+        """Swap the served certs without restart ("modifiable when
+        running", TcpLB.java:294-320): the holder is built FIRST so a
+        bad cert file leaves the old holder and cert list untouched;
+        new accepts use the new holder, in-flight sessions keep theirs."""
+        from .certkey import CertKeyHolder
+        proc = processors.get(self.protocol)
+        alpn = list(proc.alpn) if proc is not None and proc.alpn else None
+        holder = CertKeyHolder(cert_keys, alpn=alpn)  # may raise: no change
+        self.cert_keys = cert_keys
+        self.holder = holder
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        """Hot-set the idle timeout AND re-arm the per-loop idle sweeps:
+        an armed sweep waits timeout/4, so lowering the timeout without
+        re-arming would only bite after the OLD interval elapsed."""
+        self.timeout_ms = timeout_ms
+        for lid, lp in list(self._watch_loops.items()):
+            def rearm(lid=lid, lp=lp) -> None:
+                t = self._sweep_timers.pop(lid, None)
+                if t is not None:
+                    t.cancel()
+                self._sweep_armed.discard(lid)
+                if self._pump_watch.get(lid):
+                    self._arm_sweep(lp)
+            lp.run_on_loop(rearm)
+
     def _watch_pump(self, loop, pid: int, desc: str = "") -> None:
         """Track spliced-session activity; kill sessions idle > timeout_ms
         (the reference's tcpTimeout, Config.java:20 — default 15 min).
@@ -231,12 +258,11 @@ class TcpLB:
         self._pump_watch.get(id(loop), {}).pop(pid, None)
 
     def _arm_sweep(self, loop) -> None:
-        interval = max(self.timeout_ms // 4, 1000)
-
         def sweep() -> None:
             st = self._pump_watch.get(id(loop), {})
             if not st or not self.started:
                 self._sweep_armed.discard(id(loop))
+                self._sweep_timers.pop(id(loop), None)
                 return
             for pid, (last_total, last_ts, desc) in list(st.items()):
                 try:
@@ -250,14 +276,17 @@ class TcpLB:
                 elif (loop.now - last_ts) * 1000 >= self.timeout_ms:
                     st.pop(pid, None)
                     loop.pump_close(pid)
-            if st:
-                loop.delay(interval, sweep)
+            if st:  # interval re-read so hot-set timeouts take effect
+                self._sweep_timers[id(loop)] = loop.delay(
+                    max(self.timeout_ms // 4, 1000), sweep)
             else:
                 self._sweep_armed.discard(id(loop))
+                self._sweep_timers.pop(id(loop), None)
 
         if id(loop) not in self._sweep_armed:
             self._sweep_armed.add(id(loop))
-            loop.delay(interval, sweep)
+            self._sweep_timers[id(loop)] = loop.delay(
+                max(self.timeout_ms // 4, 1000), sweep)
 
     def _http_classify(self, loop, cfd: int, ip: str, port: int) -> None:
         lb = self

@@ -526,6 +526,7 @@ def _h_tl(app: Application, c: Command):
                    protocol=c.params.get("protocol", "tcp"),
                    security_group=secg,
                    in_buffer_size=int(c.params.get("in-buffer-size", 16384)),
+                   timeout_ms=int(c.params.get("timeout", 900_000)),
                    cert_keys=cks)
         lb.start()
         app.tcp_lbs[c.alias] = lb
@@ -545,6 +546,16 @@ def _h_tl(app: Application, c: Command):
         if "secg" in c.params:
             lb.security_group = _need(app.security_groups, c.params["secg"],
                                       "security-group")
+        if "timeout" in c.params:  # hot-settable (TcpLB.java:294-320)
+            lb.set_timeout(int(c.params["timeout"]))
+        if "ck" in c.params:
+            cks = [_need(app.cert_keys, a, "cert-key")
+                   for a in c.params["ck"].split(",")]
+            try:
+                lb.set_cert_keys(cks)
+            except Exception as e:  # bad cert/key file: old certs stay
+                raise CmdError(f"cert swap failed (still serving the "
+                               f"previous certs): {e}")
         return "OK"
     if c.action in ("remove", "force-remove"):
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")

@@ -69,6 +69,7 @@ def current_config(app: Application) -> str:
         lines.append(
             f"add tcp-lb {lb.alias} address {lb.bind_ip}:{lb.bind_port} "
             f"upstream {lb.backend.alias} protocol {lb.protocol} "
+            f"timeout {lb.timeout_ms} "
             f"in-buffer-size {lb.in_buffer_size}{secg_part}{ck_part}")
     for s in app.socks5_servers.values():
         flag = " allow-non-backend" if s.allow_non_backend else ""
