@@ -168,3 +168,46 @@ def test_vpc_proxy_bridges_to_host(app):
             app, "list proxy in vpc 7 in switch sw0") == []
     finally:
         target.close()
+
+
+def test_update_switch_and_socks5(app):
+    Command.execute(app, "add switch swu address 127.0.0.1:0")
+    Command.execute(app, "add vpc 4 to switch swu v4network 10.4.0.0/16")
+    assert Command.execute(
+        app, "update switch swu mac-table-timeout 60000 "
+             "arp-table-timeout 120000") == "OK"
+    sw = app.switches["swu"]
+    assert sw.mac_table_timeout_ms == 60000
+    net = sw.networks[4]
+    assert net.macs.timeout_ms == 60000 and net.arps.timeout_ms == 120000
+
+    Command.execute(app, "add upstream uu0")
+    Command.execute(app, "add security-group sgu default allow")
+    Command.execute(app,
+                    "add socks5-server s5u address 127.0.0.1:0 upstream uu0")
+    assert Command.execute(
+        app, "update socks5-server s5u security-group sgu "
+             "timeout 30000 allow-non-backend") == "OK"
+    s5 = app.socks5_servers["s5u"]
+    assert s5.security_group.alias == "sgu"
+    assert s5.timeout_ms == 30000 and s5.allow_non_backend
+    Command.execute(app, "remove socks5-server s5u")
+    Command.execute(app, "remove switch swu")
+
+
+def test_timeout_validation_and_persist_roundtrip(app):
+    from vproxy_tpu.control import persist
+
+    Command.execute(app, "add upstream uv0")
+    with pytest.raises(CmdError, match="positive"):
+        Command.execute(app, "add tcp-lb lbv address 127.0.0.1:0 "
+                             "upstream uv0 timeout 0")
+    Command.execute(app, "add socks5-server s5v address 127.0.0.1:0 "
+                         "upstream uv0 timeout 45000")
+    with pytest.raises(CmdError, match="positive"):
+        Command.execute(app, "update socks5-server s5v timeout -5")
+    cfg = persist.current_config(app)
+    s5_line = [ln for ln in cfg.splitlines()
+               if ln.startswith("add socks5-server")][0]
+    assert "timeout 45000" in s5_line
+    Command.execute(app, "remove socks5-server s5v")

@@ -39,10 +39,10 @@ class Socks5Server(TcpLB):
                  backend: Upstream,
                  security_group: Optional[SecurityGroup] = None,
                  allow_non_backend: bool = False,
-                 in_buffer_size: int = 65536):
+                 in_buffer_size: int = 65536, timeout_ms: int = 900_000):
         super().__init__(alias, acceptor, worker, bind_ip, bind_port, backend,
                          protocol="tcp", security_group=security_group,
-                         in_buffer_size=in_buffer_size)
+                         in_buffer_size=in_buffer_size, timeout_ms=timeout_ms)
         self.allow_non_backend = allow_non_backend
 
     # override: every accepted conn goes through the handshake

@@ -526,7 +526,8 @@ def _h_tl(app: Application, c: Command):
                    protocol=c.params.get("protocol", "tcp"),
                    security_group=secg,
                    in_buffer_size=int(c.params.get("in-buffer-size", 16384)),
-                   timeout_ms=int(c.params.get("timeout", 900_000)),
+                   timeout_ms=(_pos_int(c, "timeout")
+                               if "timeout" in c.params else 900_000),
                    cert_keys=cks)
         lb.start()
         app.tcp_lbs[c.alias] = lb
@@ -546,16 +547,18 @@ def _h_tl(app: Application, c: Command):
         if "secg" in c.params:
             lb.security_group = _need(app.security_groups, c.params["secg"],
                                       "security-group")
-        if "timeout" in c.params:  # hot-settable (TcpLB.java:294-320)
-            lb.set_timeout(int(c.params["timeout"]))
+        # validate/build EVERYTHING before applying anything: a failed
+        # command must not leave the LB half-updated
+        new_timeout = _pos_int(c, "timeout") if "timeout" in c.params else None
         if "ck" in c.params:
             cks = [_need(app.cert_keys, a, "cert-key")
                    for a in c.params["ck"].split(",")]
             try:
-                lb.set_cert_keys(cks)
+                lb.set_cert_keys(cks)  # builds the holder first; may raise
             except Exception as e:  # bad cert/key file: old certs stay
-                raise CmdError(f"cert swap failed (still serving the "
-                               f"previous certs): {e}")
+                raise CmdError(f"cert swap failed (nothing changed): {e}")
+        if new_timeout is not None:  # hot-settable (TcpLB.java:294-320)
+            lb.set_timeout(new_timeout)
         return "OK"
     if c.action in ("remove", "force-remove"):
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
@@ -577,7 +580,9 @@ def _h_socks5(app: Application, c: Command):
         s = Socks5Server(c.alias, aelg, elg, ip, port, ups,
                          security_group=secg,
                          allow_non_backend="allow-non-backend" in c.flags,
-                         in_buffer_size=int(c.params.get("in-buffer-size", 16384)))
+                         in_buffer_size=int(c.params.get("in-buffer-size", 16384)),
+                         timeout_ms=(_pos_int(c, "timeout")
+                                     if "timeout" in c.params else 900_000))
         s.start()
         app.socks5_servers[c.alias] = s
         return "OK"
@@ -595,6 +600,11 @@ def _h_socks5(app: Application, c: Command):
             s.allow_non_backend = False
         if "in-buffer-size" in c.params:
             s.in_buffer_size = int(c.params["in-buffer-size"])
+        if "secg" in c.params:
+            s.security_group = _need(app.security_groups, c.params["secg"],
+                                     "security-group")
+        if "timeout" in c.params:
+            s.set_timeout(_pos_int(c, "timeout"))
         return "OK"
     if c.action in ("remove", "force-remove"):
         s = _need(app.socks5_servers, c.alias, "socks5-server")
@@ -692,6 +702,19 @@ def _h_switch(app: Application, c: Command):
                 f"arp-table-timeout {s.arp_table_timeout_ms} "
                 f"bare-vxlan-access {s.bare_access.alias}"
                 for s in app.switches.values()]
+    if c.action == "update":
+        sw = _need(app.switches, c.alias, "switch")
+        # hot-set table timeouts (SwitchHandle update): existing VPC
+        # tables adopt the new TTLs immediately
+        if "mac-table-timeout" in c.params:
+            sw.mac_table_timeout_ms = _pos_int(c, "mac-table-timeout")
+            for net in sw.networks.values():
+                net.macs.timeout_ms = sw.mac_table_timeout_ms
+        if "arp-table-timeout" in c.params:
+            sw.arp_table_timeout_ms = _pos_int(c, "arp-table-timeout")
+            for net in sw.networks.values():
+                net.arps.timeout_ms = sw.arp_table_timeout_ms
+        return "OK"
     if c.action in ("remove", "force-remove"):
         if c.target is not None:
             sw = _ctx_switch(app, c)
@@ -903,6 +926,18 @@ def _h_ip(app: Application, c: Command):
         net.ips.remove(_parse_ip_str(c.alias))
         return "OK"
     raise CmdError(f"unsupported action {c.action} for ip")
+
+
+def _pos_int(c: "Command", key: str, what: str = "") -> int:
+    """Positive-integer param: `timeout 0` (or a seconds-vs-ms typo
+    going negative) would turn idle sweeps into kill-everything loops."""
+    try:
+        v = int(c.params[key])
+    except ValueError:
+        raise CmdError(f"bad {what or key}: {c.params[key]!r}")
+    if v <= 0:
+        raise CmdError(f"{what or key} must be positive, got {v}")
+    return v
 
 
 def _parse_ip_str(s: str) -> bytes:

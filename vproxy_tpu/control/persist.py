@@ -77,7 +77,8 @@ def current_config(app: Application) -> str:
                      else f" security-group {s.security_group.alias}")
         lines.append(
             f"add socks5-server {s.alias} address {s.bind_ip}:{s.bind_port} "
-            f"upstream {s.backend.alias}{secg_part}{flag}")
+            f"upstream {s.backend.alias} timeout {s.timeout_ms}"
+            f"{secg_part}{flag}")
     for d in app.dns_servers.values():
         secg_part = ("" if d.security_group.alias == "(allow-all)"
                      else f" security-group {d.security_group.alias}")
