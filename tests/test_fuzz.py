@@ -7,7 +7,10 @@ Strategy per parser: (a) pure random bytes at assorted lengths,
 (b) mutations of a VALID message (bit flips, truncations) — the mutated
 cases reach the deep branches random bytes never hit.
 """
+import os
 import random
+
+FUZZ_N = int(os.environ.get("VPROXY_TPU_FUZZ_N", "400"))
 
 from vproxy_tpu.dns import packet as dnsp
 from vproxy_tpu.net.kcp import Kcp
@@ -15,10 +18,11 @@ from vproxy_tpu.processors.hpack import Decoder, Encoder, HpackError
 from vproxy_tpu.processors.http1 import HeadParser
 from vproxy_tpu.vswitch import packets as P
 
-def corpus(valid: bytes, n=400):
+def corpus(valid: bytes, n=None):
     """Random blobs + mutations/truncations of a valid message. Seeded
     from the valid message so each test's corpus is self-contained and a
     failure reproduces when the test runs alone."""
+    n = n or FUZZ_N
     rnd = random.Random(20260730 ^ len(valid) ^ (valid[:4] or b"x")[0])
     out = []
     for _ in range(n // 2):
