@@ -299,6 +299,32 @@ def child():
     platform = dev.platform
     ph.done(platform=platform, n=len(jax.devices()))
 
+    # fixed-shape canary: the SAME gather-bound kernel every round, so
+    # artifacts from different rounds/hours can be normalized against
+    # the tunnel's measured 2.2x hour-to-hour variance (PERF_NOTES).
+    # 65536 scalar gathers per step x 64 steps — gathers are THE cost
+    # driver, so this measures the hour-class of exactly what matters.
+    ph.start("canary")
+    ctab = jnp.arange(1 << 20, dtype=jnp.int32)
+    cidx = ((jnp.arange(65536, dtype=jnp.uint32) * jnp.uint32(2654435761))
+            & ((1 << 20) - 1)).astype(jnp.int32)
+
+    @jax.jit
+    def canary_fn(tab, ix):
+        def body(i, acc):
+            return acc + jnp.sum(tab[(ix + i) & ((1 << 20) - 1)]
+                                 .astype(jnp.uint32))
+        return jax.lax.fori_loop(0, 64, body, jnp.uint32(0))
+
+    np.asarray(canary_fn(ctab, cidx))  # compile + warm
+    csamp = []
+    for _ in range(5):  # median: one tunnel stall must not skew the
+        t0 = time.time()  # normalization baseline for the whole round
+        np.asarray(canary_fn(ctab, cidx))
+        csamp.append(time.time() - t0)
+    canary_ms = float(np.median(csamp)) / 64 * 1000
+    ph.done(canary_step_ms=round(canary_ms, 3))
+
     from vproxy_tpu.rules.engine import _to_device
     _, _, _, hint_match, cidr_match, _, _ = kernel_select()
 
@@ -315,7 +341,11 @@ def child():
                   % label,
         "value": 0.0, "unit": "matches/s", "vs_baseline": 0.0,
         "platform": platform, "stage": stage, "partial": True,
+        "canary_step_ms": round(canary_ms, 3),
     }
+    if os.environ.get("BENCH_KERNEL", "fp") == "fp":
+        from vproxy_tpu.ops.fphash import default_member_mode
+        result["fp_member_mode"] = default_member_mode()
     result_file = os.environ.get("BENCH_RESULT_FILE")
 
     def flush():
@@ -523,6 +553,26 @@ def child():
     result["step_us"] = round(e2e_step_us, 1)
     flush()
 
+    # ---- tunnel RTT probe: a trivial kernel (4-int add) measures what
+    # the TRANSPORT costs per dispatch, so the latency sections below can
+    # be decomposed into design cost vs environment cost
+    # (latency_floor_us mirrors tunnel_ceiling_matches_s for throughput)
+    ph.start("rtt_probe")
+    tiny = jax.device_put(np.arange(4, dtype=np.int32))
+    inc = jax.jit(lambda v: v + 1)
+    np.asarray(inc(tiny))  # compile
+    rtts = []
+    for _ in range(_env_int("BENCH_RTT_ITERS", 20)):
+        t0 = time.time()
+        np.asarray(inc(tiny))
+        rtts.append(time.time() - t0)
+    rtt_p50 = float(np.percentile(rtts, 50) * 1e6)
+    ph.done(rtt_p50_us=round(rtt_p50, 1))
+    result["tunnel_rtt_p50_us"] = round(rtt_p50, 1)
+    # device-side latency floor for a batched classify = one kernel step
+    # (what a directly-attached chip would charge the whole batch)
+    result["latency_floor_us"] = result.get("kernel_step_us", 0.0)
+
     # ---- latency: per-dispatch submit->verdict-on-host, steady state
     lat_batch = _env_int("BENCH_LAT_BATCH", 256)
     lat = {}
@@ -551,6 +601,11 @@ def child():
                "dispatch_b%d_p50_us" % b] = round(lat[b][0], 1)
         result["dispatch_p99_us" if b == 1 else
                "dispatch_b%d_p99_us" % b] = round(lat[b][1], 1)
+        # design cost of this dispatch = measured p50 minus what the
+        # trivial-kernel probe says the transport alone costs
+        result["design_p50_us" if b == 1 else
+               "design_b%d_p50_us" % b] = round(
+            max(0.0, lat[b][0] - rtt_p50), 1)
         flush()
 
     # ---- ClassifyService accept->verdict under synthetic load
@@ -660,7 +715,8 @@ def service_section(ph, dl):
 
 # ----------------------------------------------------------- orchestrator
 
-SMOKE_ENV = {"BENCH_RULES": "1000", "BENCH_ROUTES": "500",
+SMOKE_ENV = {"VPROXY_TPU_FP_MEMBER": "reduce",  # verification-gated below
+             "BENCH_RULES": "1000", "BENCH_ROUTES": "500",
              "BENCH_ACLS": "200", "BENCH_BATCH": "512",
              "BENCH_STEPS_PER_DISPATCH": "1024",
              "BENCH_ITERS": "32", "BENCH_E2E_ITERS": "16",
@@ -668,7 +724,8 @@ SMOKE_ENV = {"BENCH_RULES": "1000", "BENCH_ROUTES": "500",
              "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25",
              "BENCH_SVC_POLICY_QUERIES": "100"}
 
-CPU_ENV = {"BENCH_ITERS": "16", "BENCH_E2E_ITERS": "8",
+CPU_ENV = {"VPROXY_TPU_FP_MEMBER": "reduce",  # CPU lowering is trusted
+           "BENCH_ITERS": "16", "BENCH_E2E_ITERS": "8",
            "BENCH_STEPS_PER_DISPATCH": "8",
            "BENCH_QUERY_SETS": "2", "BENCH_LAT_ITERS": "16",
            "BENCH_SVC_THREADS": "8", "BENCH_SVC_QUERIES": "25",
@@ -888,21 +945,58 @@ def orchestrate():
                 and res.get("chk_ok") and res.get("oracle_ok"))
 
     result = None
-    smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
-    if not (usable(smoke) and smoke.get("platform") != "cpu") and \
-            budget - (time.time() - t_start) > smoke_timeout + 120:
-        # tunnel wedges are transient (a dying previous claimant blocks
-        # the claim): one retry before surrendering the TPU headline
-        sys.stderr.write("# tpu-smoke failed; retrying once (tunnel "
-                         "claims are transient)\n")
-        time.sleep(20)  # let a dying claimant release
-        smoke = _run_stage("tpu-smoke", SMOKE_ENV, smoke_timeout, phase_file)
+    # tunnel wedges are transient (a dying previous claimant blocks the
+    # claim) but can last many minutes: retry with exponential backoff
+    # for as long as the budget allows — r4's single immediate retry
+    # lost the TPU headline to a 45-minute wedge. Compiles ride the
+    # persistent cache, so a retried smoke costs seconds, not minutes.
+    smoke_env = dict(SMOKE_ENV)
+    smoke = _run_stage("tpu-smoke", smoke_env, smoke_timeout, phase_file)
+    attempt = 0
+    while not (usable(smoke) and smoke.get("platform") != "cpu"):
+        if (smoke is not None and smoke.get("value", 0) > 0
+                and smoke.get("platform") != "cpu"
+                and not (smoke.get("chk_ok") and smoke.get("oracle_ok"))
+                and smoke_env.get("VPROXY_TPU_FP_MEMBER") != "gather"
+                and budget - (time.time() - t_start) > smoke_timeout + 120):
+            # device up but verification FAILED: the backend miscompiled
+            # the default member-eval lowering — fall back to the
+            # verified-safe gather forms instead of burning retries
+            sys.stderr.write("# tpu-smoke verification failed; falling "
+                             "back to VPROXY_TPU_FP_MEMBER=gather\n")
+            smoke_env["VPROXY_TPU_FP_MEMBER"] = "gather"
+            smoke = _run_stage("tpu-smoke", smoke_env, smoke_timeout,
+                               phase_file)
+            continue
+        wait = min(20 * (2 ** attempt), 300)
+        attempt += 1
+        if budget - (time.time() - t_start) < smoke_timeout + wait + 120 \
+                or attempt > 6:
+            break
+        sys.stderr.write(f"# tpu-smoke failed; retry {attempt} in "
+                         f"{wait}s (tunnel claims are transient)\n")
+        time.sleep(wait)
+        smoke = _run_stage("tpu-smoke", smoke_env, smoke_timeout,
+                           phase_file)
     if usable(smoke) and smoke.get("platform") != "cpu":
         result = smoke
         publish(smoke)
         remaining = budget - (time.time() - t_start) - 15
         if remaining > 90:
-            full = _run_stage("tpu-full", {}, remaining, phase_file)
+            full_env = {k: v for k, v in smoke_env.items()
+                        if k == "VPROXY_TPU_FP_MEMBER"}
+            full = _run_stage("tpu-full", full_env, remaining, phase_file)
+            if (full is not None and full.get("value", 0) > 0
+                    and not (full.get("chk_ok") and full.get("oracle_ok"))
+                    and full_env.get("VPROXY_TPU_FP_MEMBER") != "gather"
+                    and budget - (time.time() - t_start) > 120):
+                # full-size shapes can fuse differently: same fallback
+                sys.stderr.write("# tpu-full verification failed; "
+                                 "retrying with gather member mode\n")
+                full_env["VPROXY_TPU_FP_MEMBER"] = "gather"
+                full = _run_stage(
+                    "tpu-full", full_env,
+                    budget - (time.time() - t_start) - 15, phase_file)
             if usable(full):
                 result = full
                 publish(full)

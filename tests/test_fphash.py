@@ -55,18 +55,22 @@ def rand_hint():
     return Hint(host=host, port=port, uri=uri)
 
 
+MODES = ("gather", "selgather", "reduce")
+
+
 def check_hints(rules, hints):
     tab = F.compile_hint_fp(rules)
     q = F.encode_hint_queries_fp(hints, tab)
-    idx, level = F.hint_fp_match(tab.arrays, q)
-    idx, level = np.asarray(idx), np.asarray(level)
-    for i, h in enumerate(hints):
-        want = oracle.search(rules, h)
-        assert idx[i] == want, (i, h, int(idx[i]), want,
-                                rules[idx[i]] if idx[i] >= 0 else None,
-                                rules[want] if want >= 0 else None)
-        if want >= 0:
-            assert level[i] == oracle.match_level(h, rules[want])
+    for mode in MODES:
+        idx, level = F.hint_fp_match(tab.arrays, q, mode=mode)
+        idx, level = np.asarray(idx), np.asarray(level)
+        for i, h in enumerate(hints):
+            want = oracle.search(rules, h)
+            assert idx[i] == want, (mode, i, h, int(idx[i]), want,
+                                    rules[idx[i]] if idx[i] >= 0 else None,
+                                    rules[want] if want >= 0 else None)
+            if want >= 0:
+                assert level[i] == oracle.match_level(h, rules[want]), mode
 
 
 def test_hint_fp_parity_random():
@@ -219,9 +223,10 @@ def test_fp_vs_hashmatch_cross_check():
     ft = F.compile_hint_fp(rules)
     a = np.asarray(H.hint_hash_match(
         ht.arrays, H.encode_hint_queries(hints, ht))[0])
-    b = np.asarray(F.hint_fp_match(
-        ft.arrays, F.encode_hint_queries_fp(hints, ft))[0])
-    np.testing.assert_array_equal(a, b)
+    fq = F.encode_hint_queries_fp(hints, ft)
+    for mode in MODES:
+        b = np.asarray(F.hint_fp_match(ft.arrays, fq, mode=mode)[0])
+        np.testing.assert_array_equal(a, b, err_msg=mode)
 
 
 def test_engine_fp_backend_update_and_growth():

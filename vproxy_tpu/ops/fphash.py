@@ -487,9 +487,61 @@ def _member_fields(members: jnp.ndarray):
             members[..., 1], members[..., 2], members[..., 3])
 
 
-def hint_fp_match(t: dict, q: dict):
+MEMBER_MODES = ("gather", "selgather", "reduce")
+
+
+def default_member_mode() -> str:
+    """Member-evaluation lowering for hint_fp_match:
+
+    * "gather"    — the round-4 shipped form: members of EVERY slot
+      entry evaluated, q_umeta/q_hmeta fetched per member with
+      take_along_axis. Verified on the axon backend; the slowest.
+    * "selgather" — the matched entry's members are first SELECTED with
+      a masked integer SUM over the E axis (exact: the build guarantees
+      at most one fp-matched entry per slot row, _place_fp), then the
+      same take_along member evaluation runs on E-fold fewer rows.
+    * "reduce"    — entry selection as above, then member evaluation as
+      a masked MAX reduction over the lset/hmeta table axis (equality
+      mask × score) — NO take_along_axis anywhere on the member path.
+
+    The round-4 fast variants (argmax+take_along entry select, 9.56M;
+    equality-mask einsum member eval, 77M in-loop) both MISCOMPILED on
+    the axon backend in plain-jit context (PERF_NOTES.md §7, three
+    sightings: one-hot select, einsum/dot one-hot, argmax+take_along).
+    These two re-lowerings express the same math with only where+reduce
+    primitives — none of the three sighted bad patterns. Every mode
+    must still pass verify_checksum + oracle on the chip in PLAIN-jit
+    context before it ships as the default — so the LIBRARY default
+    stays "gather" (the round-4 verified form) and bench.py opts into
+    "reduce" with a verification-gated fallback. Flip the default only
+    with a committed on-chip verification artifact.
+    """
+    import os
+    mode = os.environ.get("VPROXY_TPU_FP_MEMBER", "gather")
+    if mode not in MEMBER_MODES:
+        raise ValueError(
+            f"VPROXY_TPU_FP_MEMBER={mode!r} not in {MEMBER_MODES}")
+    return mode
+
+
+def _sel_entry(ok: jnp.ndarray, mem: jnp.ndarray):
+    """Select the unique ok entry's member records via masked SUM over
+    the E axis. ok [b, P, E]; mem [b, P, E, M, 4] -> ([b, P, M, 4],
+    any-entry-matched [b, P]). Exact because at most one entry per slot
+    row can fp-match (_place_fp rejects duplicate fingerprint pairs);
+    when none matches the sum is all-zero and the caller gates on the
+    returned `any` mask (a zero record would read as rule index 0)."""
+    sel = jnp.sum(jnp.where(ok[..., None, None], mem, 0), axis=2)
+    return sel, jnp.any(ok, axis=2)
+
+
+def hint_fp_match(t: dict, q: dict, mode: Optional[str] = None):
     """-> (best rule idx [B] i32 or -1, best level [B] i32). One wide
-    row gather per probe + one 3-lane take per candidate."""
+    row gather per probe; member evaluation lowering per `mode`
+    (default_member_mode)."""
+    mode = mode or default_member_mode()
+    if mode not in MEMBER_MODES:
+        raise ValueError(f"unknown member mode {mode!r}")
     r_cap = t["rcap_iota"].shape[0]
     b = q["hp_slot"].shape[0]
     hE, hM = t["h_em"].shape
@@ -505,18 +557,26 @@ def hint_fp_match(t: dict, q: dict):
                          q.get("um_fp2", q["up_fp2"]),
                          q.get("um_score", q["up_score"])], axis=-1)
 
-    # NOTE: an equality-mask one-hot einsum select here measured ~7x
-    # faster than take_along_axis BUT miscompiles on the axon backend in
-    # some fusion contexts (step_fn diverged from the oracle while the
-    # multi-step loop and CPU stayed correct) — second sighting of the
-    # bug class after the row-packed trie select. Keep gather forms.
     def uri_side_level(lidx, uf1, uf2, ukind, shape):
         """uri_level for host-side members (kind: 0 none / 1 normal /
         2 wildcard); lidx indexes this table's lset probes."""
-        um = jnp.take_along_axis(q_umeta, lidx.reshape(b, -1, 1), axis=1)
-        um = um.reshape(shape + (3,))
-        fp_ok = (um[..., 0] == uf1) & (um[..., 1] == uf2) & (um[..., 2] > 0)
-        content = jnp.where(fp_ok, um[..., 2], 0)
+        if mode == "reduce":
+            # equality-mask max-reduction over the lset axis: the score
+            # is the ONLY value extracted, and only the l == lidx lane
+            # with matching fingerprints contributes. where+max lowers
+            # to select+reduce — not a gather, einsum, or one-hot select.
+            L = q_umeta.shape[1]
+            um_b = q_umeta.reshape((b,) + (1,) * (len(shape) - 1) + (L, 3))
+            hit = (lidx[..., None] ==
+                   jnp.arange(L, dtype=jnp.int32)) & \
+                (um_b[..., 0] == uf1[..., None]) & \
+                (um_b[..., 1] == uf2[..., None]) & (um_b[..., 2] > 0)
+            content = jnp.max(jnp.where(hit, um_b[..., 2], 0), axis=-1)
+        else:
+            um = jnp.take_along_axis(q_umeta, lidx.reshape(b, -1, 1), axis=1)
+            um = um.reshape(shape + (3,))
+            fp_ok = (um[..., 0] == uf1) & (um[..., 1] == uf2) & (um[..., 2] > 0)
+            content = jnp.where(fp_ok, um[..., 2], 0)
         wild = has_uri.reshape(
             (b,) + (1,) * (len(shape) - 1)).astype(jnp.int32)
         return jnp.where(ukind == 1, content,
@@ -526,14 +586,30 @@ def hint_fp_match(t: dict, q: dict):
     def host_side_level(hlen, hf1, hf2, hkind, shape):
         """host_level for uri-side members: exact 3 / dot-suffix 2 /
         wildcard 1, via the rolling q_hmeta fingerprints."""
-        hm = jnp.take_along_axis(q["q_hmeta"],
-                                 jnp.clip(hlen, 0, q["q_hmeta"].shape[1] - 1)
-                                 .reshape(b, -1, 1), axis=1)
-        hm = hm.reshape(shape + (3,))
-        fp_ok = (hm[..., 0] == hf1) & (hm[..., 1] == hf2)
-        qhlen = q["hlen"].reshape((b,) + (1,) * (len(shape) - 1))
-        exact = fp_ok & (hlen == qhlen)
-        suffix = fp_ok & (hm[..., 2] != 0)
+        if mode == "reduce":
+            # only two BOOLEANS are extracted (exact / dot-suffix):
+            # masked any-reduction over the rolling-fingerprint axis
+            W = q["q_hmeta"].shape[1]
+            hm_b = q["q_hmeta"].reshape(
+                (b,) + (1,) * (len(shape) - 1) + (W, 3))
+            hit = (hlen[..., None] ==
+                   jnp.arange(W, dtype=jnp.int32)) & \
+                (hm_b[..., 0] == hf1[..., None]) & \
+                (hm_b[..., 1] == hf2[..., None])
+            fp_ok = jnp.any(hit, axis=-1)
+            suffix = jnp.any(hit & (hm_b[..., 2] != 0), axis=-1)
+            qhlen = q["hlen"].reshape((b,) + (1,) * (len(shape) - 1))
+            exact = fp_ok & (hlen == qhlen)
+        else:
+            hm = jnp.take_along_axis(q["q_hmeta"],
+                                     jnp.clip(hlen, 0,
+                                              q["q_hmeta"].shape[1] - 1)
+                                     .reshape(b, -1, 1), axis=1)
+            hm = hm.reshape(shape + (3,))
+            fp_ok = (hm[..., 0] == hf1) & (hm[..., 1] == hf2)
+            qhlen = q["hlen"].reshape((b,) + (1,) * (len(shape) - 1))
+            exact = fp_ok & (hlen == qhlen)
+            suffix = fp_ok & (hm[..., 2] != 0)
         hh = has_host.reshape((b,) + (1,) * (len(shape) - 1))
         lvl = jnp.maximum(jnp.where(exact, 3, 0), jnp.where(suffix, 2, 0))
         return jnp.where(hkind == 1, lvl,
@@ -550,14 +626,6 @@ def hint_fp_match(t: dict, q: dict):
         cands.append((lv.reshape(b, -1), idx.reshape(b, -1)))
 
     # ---- ALL probe rows (host + offset uri slots) in ONE gather.
-    # NOTE: selecting the (unique) fp-matched entry per probe BEFORE
-    # member evaluation (argmax + take_along over the E axis) measured
-    # 9.56M matches/s — but miscompiled in the plain-jit context on the
-    # axon backend (third sighting: step_fn diverged from the oracle
-    # with the same wrong checksum as the einsum variant, while the
-    # fori_loop context and CPU stayed exact). The production engine
-    # dispatches through plain jits, so that variant is unshippable
-    # until the backend bug dies. Members of EVERY entry are evaluated.
     p_cnt = q["hp_slot"].shape[1]
     rows = t["rec"][jnp.concatenate([q["hp_slot"], q["up_slot"]], axis=1)]
     hew, uew = 2 + 4 * hM, 2 + 4 * uM
@@ -566,11 +634,20 @@ def hint_fp_match(t: dict, q: dict):
         (hrows[..., 1] == q["hp_fp2"][:, :, None]) & \
         (q["hp_level"][:, :, None] > 0)
     hmem = hrows[..., 2:].reshape(b, -1, hE, hM, 4)
-    mport, ukind, lidx, midx, uf1, uf2 = _member_fields(hmem)
-    ul = uri_side_level(lidx, uf1, uf2, ukind, hmem.shape[:-1])
-    hl = q["hp_level"][:, :, None, None]
-    add(jnp.where(h_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
-        jnp.where(h_ok[..., None], midx, -1), mport)
+    if mode == "gather":
+        # round-4 shipped form: members of EVERY entry evaluated
+        mport, ukind, lidx, midx, uf1, uf2 = _member_fields(hmem)
+        ul = uri_side_level(lidx, uf1, uf2, ukind, hmem.shape[:-1])
+        hl = q["hp_level"][:, :, None, None]
+        add(jnp.where(h_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
+            jnp.where(h_ok[..., None], midx, -1), mport)
+    else:
+        hsel, h_any = _sel_entry(h_ok, hmem)  # [b, P, hM, 4]
+        mport, ukind, lidx, midx, uf1, uf2 = _member_fields(hsel)
+        ul = uri_side_level(lidx, uf1, uf2, ukind, hsel.shape[:-1])
+        hl = q["hp_level"][:, :, None]
+        add(jnp.where(h_any[..., None], (hl << HOST_SHIFT) + ul, 0),
+            jnp.where(h_any[..., None], midx, -1), mport)
 
     # ---- uri-probe rows (same gather, offset slots)
     urows = rows[:, p_cnt:, : uE * uew].reshape(b, -1, uE, uew)
@@ -578,11 +655,19 @@ def hint_fp_match(t: dict, q: dict):
         (urows[..., 1] == q["up_fp2"][:, :, None]) & \
         (q["up_score"][:, :, None] > 0)
     umem = urows[..., 2:].reshape(b, -1, uE, uM, 4)
-    mport, hkind, hlen, midx, hf1, hf2 = _member_fields(umem)
-    hl = host_side_level(hlen, hf1, hf2, hkind, umem.shape[:-1])
-    ul = q["up_score"][:, :, None, None]
-    add(jnp.where(u_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
-        jnp.where(u_ok[..., None], midx, -1), mport)
+    if mode == "gather":
+        mport, hkind, hlen, midx, hf1, hf2 = _member_fields(umem)
+        hl = host_side_level(hlen, hf1, hf2, hkind, umem.shape[:-1])
+        ul = q["up_score"][:, :, None, None]
+        add(jnp.where(u_ok[..., None], (hl << HOST_SHIFT) + ul, 0),
+            jnp.where(u_ok[..., None], midx, -1), mport)
+    else:
+        usel, u_any = _sel_entry(u_ok, umem)  # [b, U, uM, 4]
+        mport, hkind, hlen, midx, hf1, hf2 = _member_fields(usel)
+        hl = host_side_level(hlen, hf1, hf2, hkind, usel.shape[:-1])
+        ul = q["up_score"][:, :, None]
+        add(jnp.where(u_any[..., None], (hl << HOST_SHIFT) + ul, 0),
+            jnp.where(u_any[..., None], midx, -1), mport)
 
     # ---- wildcard lists (broadcast, no gather)
     whm = jnp.broadcast_to(t["wh_rec"][None], (b,) + t["wh_rec"].shape)
@@ -1124,7 +1209,7 @@ def cidr_fp_match(t: dict, addr16: jnp.ndarray, fam: jnp.ndarray,
     return jnp.where(first < r_cap, first, -1)
 
 
-hint_fp_jit = jax.jit(hint_fp_match)
+hint_fp_jit = jax.jit(hint_fp_match, static_argnames=("mode",))
 cidr_fp_jit = jax.jit(cidr_fp_match)
 
 
