@@ -137,3 +137,38 @@ print(f"[6] micro-batch queue: 30 concurrent http-splice reqs -> "
       f"{_svc.stats.dispatches} device dispatches, max batch {_svc.stats.max_batch} OK")
 _lb.stop(); _g1.close(); _g2.close(); _elg.close()
 print("VERIFY SCENARIO PASSED (incl. classify queue)")
+
+# ---- 7. accept-path latency contract: lone queries under a blown device
+# budget are answered inline from the host index in microseconds, and the
+# EWMA is kept live by an off-path probe (no real query eats the probe)
+ClassifyService.reset()
+_svc7 = ClassifyService.get()
+assert _svc7.mode == "auto"
+_svc7.budget_us = 1000.0
+from vproxy_tpu.rules.engine import HintMatcher as _HM7
+_rules7 = [_HR(host=f"svc{i}.accept.example") for i in range(20000)]
+_m7 = _HM7(_rules7)
+_m7.match([_Hint.of_host("warm.example")] * 16)
+_real7 = _m7.dispatch_snap
+def _slow7(snap, hints):
+    time.sleep(0.05)  # tunnel-like 50ms device RTT
+    return _real7(snap, hints)
+_m7.dispatch_snap = _slow7
+_svc7._ewma["device"] = 50_000.0  # measured-over-budget device
+_lat7 = []
+for _i in range(200):
+    _fired = []
+    _t0 = time.perf_counter()
+    _svc7.submit_hint(_m7, _Hint.of_host(f"svc{_i}.accept.example"),
+                      lambda idx, _pl: _fired.append(idx))
+    _dt = time.perf_counter() - _t0
+    assert _fired == [_i], (_i, _fired)   # inline: answered synchronously
+    _lat7.append(_dt * 1e6)
+_lat7.sort()
+_p50, _p99 = _lat7[100], _lat7[198]
+assert _p99 < 1000, (_p50, _p99)  # way under the 5000us budget on any host
+print(f"[7] accept-path inline classify @20k rules: p50 {_p50:.1f}us "
+      f"p99 {_p99:.1f}us over 200 lone queries, "
+      f"{_svc7.stats.oracle_queries} host-indexed, "
+      f"{_svc7.stats.device_queries} device OK")
+print("VERIFY SCENARIO PASSED (incl. accept-path latency)")

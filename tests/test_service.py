@@ -356,8 +356,56 @@ def test_latency_budget_off_keeps_device_for_lone_big_queries():
     assert svc.stats.oracle_queries == 0
 
 
+def test_inline_host_path_is_synchronous_and_probes_off_path():
+    """Budget-rerouted lone queries are answered INLINE on the
+    submitting thread (no dispatcher hop — the accept-path latency
+    contract), and the device EWMA is refreshed by an off-path probe
+    thread, never by making a real query eat the device round trip."""
+    import threading as _t
+
+    svc = ClassifyService.get()
+    assert svc.mode == "auto"
+    svc.budget_us = 1000.0
+    svc._ewma["device"] = 50_000.0  # over budget -> host path
+    m = HintMatcher(mk_rules(300))
+    m.match([Hint.of_host("warm.example.com")] * 16)
+
+    probe_seen = _t.Event()
+    real = m.dispatch_snap
+
+    def slow(snap, hints):
+        probe_seen.set()          # only the probe thread gets here
+        time.sleep(0.02)
+        return real(snap, hints)
+
+    m.dispatch_snap = slow
+    caller = _t.get_ident()
+    hits = []
+    from vproxy_tpu.rules.service import PROBE_EVERY
+    for i in range(PROBE_EVERY + 2):
+        fired = []
+        svc.submit_hint(m, Hint.of_host(f"svc{i % 300}.example.com"),
+                        lambda idx, _pl: fired.append(
+                            (idx, _t.get_ident())))
+        # inline contract: the callback already ran, on THIS thread
+        assert fired and fired[0][1] == caller, i
+        hits.append(fired[0][0])
+    assert hits[:4] == [0, 1, 2, 3]
+    assert probe_seen.wait(5)     # the off-path probe fired...
+    for _ in range(100):          # ...and refreshed the device EWMA
+        if svc._ewma["device"] != 50_000.0:
+            break
+        time.sleep(0.05)
+    assert svc._ewma["device"] != 50_000.0
+    # every query was served by the host index, none by the device
+    assert svc.stats.oracle_queries >= PROBE_EVERY + 2
+
+
 def test_micro_batches_always_ride_device_despite_budget():
-    """n >= 2 is never rerouted by the budget policy."""
+    """n >= 2 is never rerouted by the budget policy: the policy only
+    gates LONE queries (which the inline fast path serves from the host
+    index); any batch that forms rides the device regardless of how bad
+    the device EWMA looks."""
     svc = ClassifyService.get()
     assert svc.mode == "auto"
     svc.budget_us = 1.0  # absurdly tight budget
@@ -365,6 +413,11 @@ def test_micro_batches_always_ride_device_despite_budget():
     svc._ewma["oracle"] = 10.0
     m = HintMatcher(mk_rules(300))
     m.match([Hint.of_host("warm.example.com")] * 16)
+    # the routing contract, at the decision point the dispatcher uses
+    assert svc._use_device(m, 2)      # micro-batch: always the device
+    assert svc._use_device(m, 100)
+    assert not svc._lone_path_is_device()  # lone over budget: host
+    # and a burst stays correct end-to-end whichever path served it
     n = 50
     cb, results, done = collect(n)
     for i in range(n):
@@ -373,8 +426,7 @@ def test_micro_batches_always_ride_device_despite_budget():
     assert done.wait(30)
     for i in range(n):
         assert results[i] == i
-    # the dispatcher may drain a few lone requests (rerouted by the
-    # budget) before submissions pile up, but every micro-batch (n>=2)
-    # must ride the device regardless of the absurd budget
-    assert svc.stats.max_batch >= 2
-    assert svc.stats.device_queries >= n - 10
+    # with the device over budget every lone submission was answered
+    # inline from the host index — no device round trip on the path
+    assert svc.stats.oracle_queries >= n - 10
+    assert svc.stats.budget_reroutes >= n - 10

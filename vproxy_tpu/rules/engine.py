@@ -114,13 +114,15 @@ class HintMatcher:
         self._caps: Optional[dict] = None
         self._mesh = mesh  # jax-sharded only (lazily defaulted)
         self._fn = None    # jax-sharded jitted matcher (shape-agnostic)
-        # (tab, dev, rules, payload) published as ONE tuple so concurrent
-        # readers (the ClassifyService dispatcher) never see a torn
-        # table/rule/payload version across a set_rules() swap; `payload`
-        # is an opaque owner-supplied object versioned WITH the rules
-        # (e.g. Upstream's GroupHandle list) so a matched index is always
-        # interpreted against the same generation it was matched in
-        self._pub: tuple = (None, None, [], payload)
+        # (tab, dev, rules, payload, index) published as ONE tuple so
+        # concurrent readers (the ClassifyService dispatcher) never see a
+        # torn table/rule/payload version across a set_rules() swap;
+        # `payload` is an opaque owner-supplied object versioned WITH the
+        # rules (e.g. Upstream's GroupHandle list) so a matched index is
+        # always interpreted against the same generation it was matched
+        # in; `index` is the O(probes) host-side HintIndex the latency
+        # budget policy answers lone queries from (rules/index.py)
+        self._pub: tuple = (None, None, [], payload, None)
         self._payload = payload
         self._recompile()
 
@@ -176,7 +178,16 @@ class HintMatcher:
                 cap = None  # outgrew capacity: let the compiler pick a bucket
             tab = T.compile_hint_rules(self._rules, cap=cap)
             self._dev = _to_device(table_arrays(tab))
-        self._pub = (self._tab, self._dev, list(self._rules), self._payload)
+        idx = None
+        # small tables answer lone queries with the linear oracle (the
+        # same crossover match_one uses), so the index build — a second
+        # O(rules) bucket construction on the update path — only pays
+        # for itself past SMALL_TABLE
+        if self.backend != "host" and len(self._rules) > SMALL_TABLE:
+            from .index import HintIndex
+            idx = HintIndex(self._rules)
+        self._pub = (self._tab, self._dev, list(self._rules), self._payload,
+                     idx)
 
     def encode(self, hints: Sequence[Hint]) -> dict:
         """Pre-encode a query batch for submit() (hash backend only).
@@ -218,13 +229,22 @@ class HintMatcher:
     def oracle_snap(self, snap: tuple, hint: Hint) -> int:
         return oracle.search(snap[2], hint)
 
+    def index_snap(self, snap: tuple, hint: Hint) -> int:
+        """O(probes) host lookup against the snapshot's HintIndex (same
+        winner as oracle_snap); falls back to the linear oracle when the
+        snapshot has no index (host backend)."""
+        idx = snap[4] if len(snap) > 4 else None
+        if idx is None:
+            return oracle.search(snap[2], hint)
+        return idx.lookup(hint)
+
     def oracle_one(self, hint: Hint) -> int:
         return self.oracle_snap(self._pub, hint)
 
     def dispatch_snap(self, snap: tuple, hints: Sequence[Hint]):
         """Encode + submit one batch against the snapshotted table
         generation (async device result; np.asarray() it to block)."""
-        tab, dev, rules, _ = snap
+        tab, dev, rules = snap[0], snap[1], snap[2]
         if not rules or not hints:
             return np.full(len(hints), -1, np.int32)
         if self.backend == "jax":
@@ -276,9 +296,9 @@ class CidrMatcher:
         self._tab = None   # jax-sharded stacked table meta
         self._mesh = mesh  # jax-sharded only (lazily defaulted)
         self._fns: dict = {}  # jax-sharded jitted fns keyed by with_port
-        # (dev, nets, acl, payload[, tab]) — one atomic generation (see
-        # HintMatcher._pub for the why)
-        self._pub: tuple = (None, [], None, payload, None)
+        # (dev, nets, acl, payload, tab, index) — one atomic generation
+        # (see HintMatcher._pub for the why)
+        self._pub: tuple = (None, [], None, payload, None, None)
         self._payload = payload
         self._recompile()
 
@@ -329,9 +349,13 @@ class CidrMatcher:
                 cap = None
             tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
             self._dev = _to_device(table_arrays(tab))
+        idx = None
+        if self.backend != "host" and len(self._nets) > SMALL_TABLE:
+            from .index import CidrIndex
+            idx = CidrIndex(self._nets, acl=self._acl)
         self._pub = (self._dev, list(self._nets),
                      None if self._acl is None else list(self._acl),
-                     self._payload, self._tab)
+                     self._payload, self._tab, idx)
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
@@ -377,6 +401,16 @@ class CidrMatcher:
                     (acl[j].min_port <= port <= acl[j].max_port)):
                 return j
         return -1
+
+    def index_snap(self, snap: tuple, addr: bytes,
+                   port: Optional[int] = None) -> int:
+        """O(groups) host lookup against the snapshot's CidrIndex (same
+        winner as oracle_snap); linear fallback without one."""
+        idx = snap[5] if len(snap) > 5 else None
+        if idx is None:
+            return self.oracle_snap(snap, addr, port)
+        # route tables ignore ports entirely (oracle_snap's acl gate)
+        return idx.lookup(addr, None if snap[2] is None else port)
 
     def dispatch_snap(self, snap: tuple, addrs: Sequence[bytes],
                       ports: Optional[Sequence[int]]):

@@ -28,11 +28,18 @@ Latency budget (VPROXY_TPU_CLASSIFY_BUDGET_US, default 5000; 0 = off):
 in "auto" mode a LONE query against a big table normally rides the
 device and eats a full device round trip on the accept path. With a
 budget set, the service tracks per-path EWMA latencies for lone queries
-(device dispatch vs host-oracle scan) and routes lone queries to the
-oracle when the device round trip exceeds the budget and the oracle is
-faster; the device is re-probed every PROBE_EVERY-th lone query so the
-EWMA tracks tunnel/device conditions. Micro-batches (n >= 2) always
-ride the device — batching is the whole point.
+(device dispatch vs host lookup) and serves lone queries INLINE on the
+submitting thread from the snapshot's O(probes) host index
+(rules/index.py — exact, ~2-10us) when the device round trip exceeds
+the budget: no dispatcher-thread hop, no device RTT, which is what
+makes the BASELINE p99 < 50us accept-path contract meetable even when
+the device sits behind a slow transport. The device EWMA is kept live
+by OFF-PATH probes: every PROBE_EVERY-th rerouted lone query spawns a
+one-shot probe thread that times a synthetic device dispatch, so real
+accept-path queries never eat the probe cost (the round-4 policy rode
+probes on real queries, putting device RTT spikes straight into the
+reported p99). Micro-batches (n >= 2) always ride the device —
+batching is the whole point.
 
 Every delivered query also records submit->delivery latency into a
 fixed reservoir; stats.latency_percentiles() surfaces p50/p99 (the
@@ -94,13 +101,21 @@ class ClassifyStats:
         self.failovers = 0        # device errors that degraded a batch
         self.max_batch = 0
         self.budget_reroutes = 0  # lone queries sent to oracle by budget
-        # submit->delivery latency reservoir (dispatcher-thread writes)
+        # submit->delivery latency reservoir. Writers are the dispatcher
+        # thread AND every inline-answering submit thread, so all
+        # read-modify-writes go through `lock` (bump/record_latency)
+        self.lock = threading.Lock()
         self._lat = np.zeros(LAT_RESERVOIR, np.float64)
         self._lat_n = 0
 
+    def bump(self, name: str, n: int = 1) -> None:
+        with self.lock:
+            setattr(self, name, getattr(self, name) + n)
+
     def record_latency(self, seconds: float) -> None:
-        self._lat[self._lat_n % LAT_RESERVOIR] = seconds
-        self._lat_n += 1
+        with self.lock:
+            self._lat[self._lat_n % LAT_RESERVOIR] = seconds
+            self._lat_n += 1
 
     def latency_percentiles(self) -> Optional[dict]:
         """p50/p99 submit->delivery latency in us over the reservoir."""
@@ -148,7 +163,14 @@ class ClassifyService:
         self.budget_us = BUDGET_US
         # lone-query EWMA latency (us) per path, None until first sample
         self._ewma = {"device": None, "oracle": None}
+        self._elock = threading.Lock()
         self._lone_seen = 0
+        # persistent probe worker: the inline accept path only hands it
+        # a request + notify (~1us); spawning a Thread per probe costs
+        # ~200us and was visible in the accept-path p99
+        self._probe_req: Optional[tuple] = None
+        self._probe_cv = threading.Condition()
+        self._probe_thread: Optional[threading.Thread] = None
         self.stats = ClassifyStats()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -175,21 +197,148 @@ class ClassifyService:
         self._submit("cidr", matcher, (addr, port), cb, loop)
 
     def _submit(self, kind: str, matcher, payload, cb, loop) -> None:
+        inline = False
         with self._cv:
             if self._closed:
                 raise OSError("ClassifyService is closed")
             self.stats.queries += 1
             key = id(matcher)
             ent = self._pending.get(key)
-            if ent is None:
+            if ent is None and self._inline_host(matcher):
+                inline = True  # answered below, outside the lock
+            elif ent is None:
                 self._pending[key] = (kind, matcher, [_Req(payload, cb, loop)])
             else:
                 ent[2].append(_Req(payload, cb, loop))
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._run, name="classify-dispatch", daemon=True)
-                self._thread.start()
-            self._cv.notify()
+            if not inline:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="classify-dispatch",
+                        daemon=True)
+                    self._thread.start()
+                self._cv.notify()
+        if inline:
+            self._answer_inline(kind, matcher, payload, cb, loop)
+
+    def _inline_host(self, matcher) -> bool:
+        """Lone query, nothing pending for this matcher: answer it
+        synchronously on the submitting thread from the host index when
+        that is the right path — small table (the oracle crossover), the
+        device marked down, or the budget policy preferring the host.
+        Called under the lock; must stay O(1)."""
+        if self.mode != "auto":
+            return False
+        if getattr(matcher, "backend", "host") == "host":
+            return True
+        if time.monotonic() < self._device_down_until:
+            return True
+        if matcher.size() <= SMALL_TABLE:
+            return True
+        if self.budget_us <= 0:
+            return False
+        dev = self._ewma["device"]
+        if dev is None or dev <= self.budget_us:
+            return False          # ride the device (measures the EWMA)
+        self.stats.budget_reroutes += 1
+        return True
+
+    def _answer_inline(self, kind: str, matcher, payload, cb, loop) -> None:
+        """Serve one lone query from the snapshot's host index, inline.
+        Every PROBE_EVERY-th rerouted query also hands the off-path
+        probe worker a request so the device EWMA tracks current
+        conditions without any real query eating the probe cost.
+        Delivery keeps the loop-confinement contract: run_on_loop runs
+        the callback immediately when the submitter IS the loop thread
+        (the accept path — fully synchronous), else queues it there."""
+        t0 = time.monotonic()
+        snap = matcher.snapshot()
+        big = matcher.size() > SMALL_TABLE
+        try:
+            if kind == "hint":
+                i = matcher.index_snap(snap, payload)
+            else:
+                i = matcher.index_snap(snap, payload[0], payload[1])
+        except MemoryError:
+            raise
+        except Exception:
+            _log.error("inline classify failed; delivering no-match",
+                       exc=True)
+            i = -1
+        dt = time.monotonic() - t0
+        st = self.stats
+        with st.lock:
+            st.oracle_queries += 1
+            st.max_batch = max(st.max_batch, 1)
+            st._lat[st._lat_n % LAT_RESERVOIR] = dt
+            st._lat_n += 1
+        if big:
+            self._note_lone_latency("oracle", dt)
+            with self._elock:
+                self._lone_seen += 1
+                probe = self._lone_seen % PROBE_EVERY == 0
+            if probe and self.device_ok():
+                self._spawn_probe(kind, matcher, payload)
+        i = int(i)
+        pl = matcher.snap_payload(snap)
+
+        def run(cb=cb, i=i, pl=pl) -> None:
+            try:
+                cb(i, pl)
+            except MemoryError:
+                raise
+            except Exception:
+                _log.error("classify callback failed", exc=True)
+
+        if loop is None or not loop.run_on_loop(run):
+            run()
+
+    def _spawn_probe(self, kind: str, matcher, payload) -> None:
+        """Hand (kind, matcher, payload) to the persistent probe worker;
+        at most one probe in flight (a slow tunnel must not queue up),
+        and the accept path pays only a notify."""
+        with self._probe_cv:
+            if self._probe_req is not None:
+                return
+            self._probe_req = (kind, matcher, payload)
+            if self._probe_thread is None:
+                self._probe_thread = threading.Thread(
+                    target=self._probe_run, name="classify-probe",
+                    daemon=True)
+                self._probe_thread.start()
+            self._probe_cv.notify()
+
+    def _probe_run(self) -> None:
+        while True:
+            with self._probe_cv:
+                while self._probe_req is None:
+                    if self._closed:
+                        return
+                    self._probe_cv.wait(1.0)
+                kind, matcher, payload = self._probe_req
+            try:
+                snap = matcher.snapshot()
+                t0 = time.monotonic()
+                # pad exactly like _device_batch: the probe must time the
+                # SAME compiled program real dispatches run, not trigger
+                # a fresh batch-1 trace whose compile time poisons the
+                # EWMA for hundreds of queries
+                np.asarray(self._probe_dispatch(kind, matcher, snap,
+                                                payload))
+                self._note_lone_latency("device", time.monotonic() - t0)
+            except MemoryError:
+                raise
+            except Exception as e:
+                self.stats.failovers += 1
+                self._device_down_until = time.monotonic() + self.retry_s
+                _log.alert(f"device probe failed ({e!r}); device marked "
+                           f"down for {self.retry_s:.0f}s")
+            finally:
+                with self._probe_cv:
+                    self._probe_req = None
+
+    def _probe_dispatch(self, kind: str, matcher, snap, payload):
+        return self._device_batch(kind, matcher, snap,
+                                  [_Req(payload, None, None)])
 
     # ---------------------------------------------------------- dispatcher
 
@@ -237,31 +386,21 @@ class ClassifyService:
         return self._lone_path_is_device()
 
     def _lone_path_is_device(self) -> bool:
-        """Budget policy for a lone query against a big table: prefer the
-        device, but when its measured round trip blows the latency budget
-        and the host oracle is faster, reroute. Either path is re-probed
-        periodically so the EWMAs track current conditions."""
+        """Budget policy for a lone query that reached the dispatcher
+        (the inline gate already served budget-rerouted ones): ride the
+        device while it is unmeasured or within budget."""
         if self.budget_us <= 0:
             return True
-        self._lone_seen += 1
-        dev, orc = self._ewma["device"], self._ewma["oracle"]
-        if dev is None:
-            return True           # first lone query: measure the device
-        if dev <= self.budget_us:
-            return True           # device round trip within budget
-        # over budget: prefer the faster path, but flip to the other one
-        # every PROBE_EVERY-th query so a stale EWMA can't pin the choice
-        prefer_dev = orc is not None and dev <= orc
-        if self._lone_seen % PROBE_EVERY == 0:
-            return not prefer_dev
-        if not prefer_dev:
-            self.stats.budget_reroutes += 1
-        return prefer_dev
+        dev = self._ewma["device"]
+        return dev is None or dev <= self.budget_us
 
     def _note_lone_latency(self, path: str, seconds: float) -> None:
+        # writers: inline submit threads, the probe worker, and the
+        # dispatcher — the EWMA read-modify-write needs the lock
         us = seconds * 1e6
-        cur = self._ewma[path]
-        self._ewma[path] = us if cur is None else 0.8 * cur + 0.2 * us
+        with self._elock:
+            cur = self._ewma[path]
+            self._ewma[path] = us if cur is None else 0.8 * cur + 0.2 * us
 
     def _dispatch(self, kind: str, matcher, reqs: list[_Req]) -> None:
         if kind == "cidr":
@@ -278,7 +417,8 @@ class ClassifyService:
 
     def _dispatch_uniform(self, kind: str, matcher, reqs: list[_Req]) -> None:
         n = len(reqs)
-        self.stats.max_batch = max(self.stats.max_batch, n)
+        with self.stats.lock:  # inline submit threads write stats too
+            self.stats.max_batch = max(self.stats.max_batch, n)
         snap = matcher.snapshot()  # ONE generation for device/oracle/payload
         lone_big = n == 1 and matcher.size() > SMALL_TABLE
         idxs = None
@@ -288,12 +428,13 @@ class ClassifyService:
                 idxs = self._device_batch(kind, matcher, snap, reqs)
                 if lone_big:
                     self._note_lone_latency("device", time.monotonic() - t0)
-                self.stats.dispatches += 1
-                self.stats.device_queries += n
+                with self.stats.lock:
+                    self.stats.dispatches += 1
+                    self.stats.device_queries += n
             except MemoryError:
                 raise
             except Exception as e:
-                self.stats.failovers += 1
+                self.stats.bump("failovers")
                 self._device_down_until = time.monotonic() + self.retry_s
                 _log.alert(f"device classify failed ({e!r}); serving from "
                            f"host oracle, retry in {self.retry_s:.0f}s")
@@ -302,7 +443,7 @@ class ClassifyService:
             idxs = self._oracle_batch(kind, matcher, snap, reqs)
             if lone_big:
                 self._note_lone_latency("oracle", time.monotonic() - t0)
-            self.stats.oracle_queries += n
+            self.stats.bump("oracle_queries", n)
         self._deliver(reqs, idxs, matcher.snap_payload(snap))
 
     def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
@@ -323,9 +464,12 @@ class ClassifyService:
 
     def _oracle_batch(self, kind: str, matcher, snap,
                       reqs: list[_Req]) -> list[int]:
+        """Host-served batch (device down / host path): rides the
+        snapshot's O(probes) index — same winner as the linear oracle
+        (rules/index.py parity tests), O(table) cheaper per query."""
         if kind == "hint":
-            return [matcher.oracle_snap(snap, r.payload) for r in reqs]
-        return [matcher.oracle_snap(snap, r.payload[0], r.payload[1])
+            return [matcher.index_snap(snap, r.payload) for r in reqs]
+        return [matcher.index_snap(snap, r.payload[0], r.payload[1])
                 for r in reqs]
 
     def _deliver(self, reqs: list[_Req], idxs, payload=None) -> None:
@@ -359,3 +503,5 @@ class ClassifyService:
         with self._cv:
             self._closed = True
             self._cv.notify()
+        with self._probe_cv:  # wake the probe worker so it exits
+            self._probe_cv.notify()
