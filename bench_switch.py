@@ -95,6 +95,9 @@ def build_world(backend):
             pkt.to_bytes()
             CountingIface.sent += 1
 
+        def send_vxlan_raw(self, iface_sw, data) -> None:
+            CountingIface.sent += 1
+
     counter = CountingIface()
     dst_mac = b"\x02\xfe\x00\x00\x00\x01"
     net2.macs.record(dst_mac, counter)
@@ -118,16 +121,26 @@ def replay(loop, sw, counter, dgrams, secs):
     """Replay bursts on the loop thread until the window closes."""
     burst = sw.RECV_BURST
     chunks = [dgrams[i:i + burst] for i in range(0, len(dgrams), burst)]
-    # warmup: first burst pays the jit compiles
-    loop.call_sync(lambda: sw._input_batch(chunks[0]), timeout=600)
+    # warmup: pays the jit compiles AND the fast path's cache builds
+    # (route/acl tries, arp/mac views, remote entries) for ~1s so the
+    # timed window measures steady state
+    warm_deadline = time.perf_counter() + min(1.0, secs / 4)
+    while time.perf_counter() < warm_deadline:
+        for ch in chunks:
+            loop.call_sync(lambda c=ch: sw._input_batch(c), timeout=600)
     counter.sent = 0
     n_in = 0
     t0 = time.perf_counter()
     deadline = t0 + secs
-    while time.perf_counter() < deadline:
+    # one loop-thread handoff per SWEEP (not per chunk): the ~0.3ms
+    # call_sync round trip was charging the data plane ~0.5us/pkt of
+    # pure bench-harness cost
+    def sweep():
         for ch in chunks:
-            loop.call_sync(lambda c=ch: sw._input_batch(c), timeout=600)
-            n_in += len(ch)
+            sw._input_batch(ch)
+    while time.perf_counter() < deadline:
+        loop.call_sync(sweep, timeout=600)
+        n_in += len(dgrams)
         if not sys.stdout.isatty():
             sys.stderr.flush()
     dt = time.perf_counter() - t0

@@ -143,3 +143,42 @@ def test_dns_recursion_via_fake_upstream(dns_stack):
     resp = dns_query(d.bind_port, "anything.example.com.")
     assert resp.answers and resp.answers[0].rdata == parse_ip("7.7.7.7")
     up.close()
+
+
+def test_dns_vproxy_local_introspection():
+    """DNSServer.java:150-157 + runInternal :339-349: who.am.i answers
+    the requester's address, who.are.you the server's; the resource
+    extension resolves a LIVE tcp-lb's bind address via the control
+    plane's resolver (VERDICT r4 item 7)."""
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+
+    app = Application.create(workers=1)
+    try:
+        run = lambda line: Command.execute(app, line)
+        run("add upstream ups0")
+        run("add server-group sg0 timeout 400 period 200 up 1 down 3 "
+            "method wrr")
+        run("add server-group sg0 to upstream ups0")
+        run("add tcp-lb web address 127.0.0.1:0 upstream ups0")
+        run("add dns-server dns0 address 127.0.0.1:0 upstream ups0")
+        d = app.dns_servers["dns0"]
+
+        resp = dns_query(d.bind_port, "who.am.i.vproxy.local.")
+        assert resp.rcode == 0
+        assert resp.answers[0].rdata == parse_ip("127.0.0.1")
+
+        resp = dns_query(d.bind_port, "who.are.you.vproxy.local.")
+        assert resp.answers[0].rdata == parse_ip("127.0.0.1")
+
+        # live tcp-lb resolved from running resource state
+        resp = dns_query(d.bind_port, "web.tcp-lb.vproxy.local.")
+        assert resp.rcode == 0
+        assert resp.answers and resp.answers[0].rdata == \
+            parse_ip(app.tcp_lbs["web"].bind_ip)
+
+        # unknown resource under .vproxy.local: NOT recursed, empty
+        resp = dns_query(d.bind_port, "nope.tcp-lb.vproxy.local.")
+        assert resp.rcode == 0 and not resp.answers
+    finally:
+        app.close()

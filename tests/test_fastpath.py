@@ -1,0 +1,237 @@
+"""SwitchFastPath (vswitch/fastpath.py) vs the object pipeline.
+
+The fast path claims bit-exact forwarding for its two hot cases and
+transparent fallback for everything else. These tests drive the SAME
+burst through two identically-configured switches — fast path on vs
+off — and compare every egressed datagram (parsed, order-insensitive
+per flow) plus the mac/arp table end states. Checksum math is verified
+against a full header recompute.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from vproxy_tpu.components.secgroup import SecurityGroup
+from vproxy_tpu.net.eventloop import SelectorEventLoop
+from vproxy_tpu.rules.ir import AclRule, Proto, RouteRule
+from vproxy_tpu.utils.ip import Network, parse_ip
+from vproxy_tpu.vswitch import packets as P
+from vproxy_tpu.vswitch.switch import Switch, synthetic_mac
+
+
+class RecIface:
+    """Recording egress iface with raw support."""
+
+    local_side_vni = 0
+
+    def __init__(self, name):
+        self.name = name
+        self.frames: list[bytes] = []
+
+    def send_vxlan(self, sw, pkt) -> None:
+        self.frames.append(pkt.to_bytes())
+
+    def send_vxlan_raw(self, sw, data) -> None:
+        self.frames.append(data)
+
+
+class ObjOnlyIface(RecIface):
+    """No raw support: fast path must fall back to the object path."""
+
+    send_vxlan_raw = None
+
+
+def mk_world(fastpath: bool, out_cls=RecIface, acl_rules=None,
+             default_allow=True):
+    os.environ["VPROXY_TPU_SWITCH_FASTPATH"] = "1" if fastpath else "0"
+    try:
+        loop = SelectorEventLoop("fp-t")
+        loop.loop_thread()
+        sg = SecurityGroup("t", default_allow=default_allow)
+        if acl_rules:
+            sg.extend_rules(acl_rules)
+        sw = Switch("swt", loop, "127.0.0.1", 0, bare_vxlan_access=sg)
+        sw.start()
+        n1 = sw.add_network(101, Network.parse("10.1.0.0/16"))
+        n2 = sw.add_network(102, Network.parse("10.2.0.0/16"))
+        gw1 = parse_ip("10.1.0.1")
+        n1.ips.add(gw1, synthetic_mac(101, gw1))
+        s2 = parse_ip("10.2.255.254")
+        n2.ips.add(s2, synthetic_mac(102, s2))
+        for i in range(40):
+            n1.add_route(RouteRule(f"r{i}",
+                                   Network.parse(f"10.2.{i}.0/24"),
+                                   to_vni=102))
+        out = out_cls("out")
+        dst_mac = b"\x02\xfe\x00\x00\x00\x01"
+        n2.macs.record(dst_mac, out)
+        for i in range(40):
+            for c in (1, 2, 3):
+                n2.arps.record(bytes([10, 2, i, c]), dst_mac)
+        # an L2 peer in vni 101 (known unicast)
+        l2out = out_cls("l2out")
+        l2_mac = b"\x02\xee\x00\x00\x00\x07"
+        n1.macs.record(l2_mac, l2out)
+        return loop, sw, n1, n2, out, l2out
+    finally:
+        os.environ.pop("VPROXY_TPU_SWITCH_FASTPATH", None)
+
+
+def mk_burst(n=200):
+    """Mixed burst: routed-v4 (fast), known-unicast L2 (fast), arp
+    (slow), icmp-to-switch-ip (slow), ttl-expired (slow), route miss
+    (drop), v6 ethertype (slow)."""
+    gw1_mac = synthetic_mac(101, parse_ip("10.1.0.1"))
+    l2_mac = b"\x02\xee\x00\x00\x00\x07"
+    burst = []
+    for i in range(n):
+        src_mac = bytes([0x02, 0xaa, 0, 0, i >> 8, i & 255])
+        src_ip = bytes([10, 1, (i >> 8) & 255, 1 + (i % 250)])
+        kind = i % 8
+        if kind < 4:  # routed v4 (fast)
+            ip = P.Ipv4(src=src_ip, dst=bytes([10, 2, i % 40, 1 + i % 3]),
+                        proto=17, payload=b"u" * (10 + i % 5), ttl=64)
+            eth = P.Ethernet(gw1_mac, src_mac, 0x0800, b"", packet=ip)
+        elif kind == 4:  # known-unicast L2 (fast)
+            ip = P.Ipv4(src=src_ip, dst=bytes([10, 1, 9, 9]),
+                        proto=17, payload=b"l2", ttl=9)
+            eth = P.Ethernet(l2_mac, src_mac, 0x0800, b"", packet=ip)
+        elif kind == 5:  # arp request to the gateway (slow, learns)
+            arp = P.Arp(P.ARP_REQUEST, sha=src_mac, spa=src_ip,
+                        tha=b"\x00" * 6, tpa=parse_ip("10.1.0.1"))
+            eth = P.Ethernet(P.BROADCAST_MAC, src_mac, P.ETHER_TYPE_ARP,
+                             b"", arp)
+        elif kind == 6:  # ttl expired on the routed path (slow)
+            ip = P.Ipv4(src=src_ip, dst=bytes([10, 2, 1, 1]),
+                        proto=17, payload=b"t", ttl=1)
+            eth = P.Ethernet(gw1_mac, src_mac, 0x0800, b"", packet=ip)
+        else:  # route miss (consumed drop both paths)
+            ip = P.Ipv4(src=src_ip, dst=bytes([10, 77, 1, 1]),
+                        proto=17, payload=b"m", ttl=64)
+            eth = P.Ethernet(gw1_mac, src_mac, 0x0800, b"", packet=ip)
+        burst.append((P.Vxlan(101, eth).to_bytes(),
+                      f"127.0.0.{1 + i % 9}", 40000 + i % 13))
+    return burst
+
+
+def _norm(frames):
+    """Parse + normalize egressed frames for comparison (vni, macs,
+    ttl, checksum, ip header fields, payload)."""
+    out = []
+    for f in frames:
+        vx = P.Vxlan.parse(f)
+        e = vx.ether
+        rec = [vx.vni, e.dst.hex(), e.src.hex(), e.ether_type]
+        p = e.packet
+        if isinstance(p, P.Ipv4):
+            rec += [p.src.hex(), p.dst.hex(), p.ttl, p.proto,
+                    bytes(p.payload).hex()]
+            # independent checksum validation on the raw bytes
+            raw = f[22:42]
+            hdr = bytearray(raw)
+            want = (hdr[10] << 8) | hdr[11]
+            hdr[10:12] = b"\x00\x00"
+            assert P.checksum(bytes(hdr)) == want, "bad ip checksum"
+        elif isinstance(p, P.Arp):
+            rec += [p.op, p.sha.hex(), p.spa.hex(), p.tpa.hex()]
+        out.append(tuple(rec))
+    return sorted(out)
+
+
+def run_both(burst, **kw):
+    res = []
+    for fast in (True, False):
+        loop, sw, n1, n2, out, l2out = mk_world(fast, **kw)
+        assert (sw.fastpath is not None) == fast
+        try:
+            loop.call_sync(lambda: sw._input_batch(list(burst)),
+                           timeout=120)
+            time.sleep(0.05)
+            res.append((_norm(out.frames), _norm(l2out.frames),
+                        sorted(m for m, _ in n1.macs.entries()),
+                        sorted(a for a, _ in n1.arps.entries()),
+                        sorted(a for a, _ in n2.arps.entries())))
+        finally:
+            sw.stop()
+            loop.close()
+    return res
+
+
+def test_fastpath_parity_mixed_burst():
+    fast, slow = run_both(mk_burst(200))
+    assert fast[0] == slow[0], "routed egress diverged"
+    assert len(fast[0]) > 0
+    assert fast[1] == slow[1], "l2 egress diverged"
+    assert fast[2] == slow[2], "mac learns diverged"
+    assert fast[3] == slow[3], "ingress arp learns diverged"
+    assert fast[4] == slow[4]
+
+
+def test_fastpath_parity_with_acl():
+    acls = [AclRule("deny7", Network.parse("127.0.0.7/32"),
+                    Proto.UDP, 0, 65535, False),
+            AclRule("allow-lo", Network.parse("127.0.0.0/8"),
+                    Proto.UDP, 0, 65535, True)]
+    fast, slow = run_both(mk_burst(200), acl_rules=acls,
+                          default_allow=False)
+    assert fast[0] == slow[0]
+    assert len(fast[0]) > 0
+    # sender .7 really was denied: fewer egressed than the no-acl run
+    noacl, _ = run_both(mk_burst(200))
+    assert len(fast[0]) < len(noacl[0])
+
+
+def test_fastpath_falls_back_without_raw_egress():
+    fast, slow = run_both(mk_burst(200), out_cls=ObjOnlyIface)
+    assert fast[0] == slow[0]
+    assert len(fast[0]) > 0
+
+
+def test_fastpath_vni_override_parity():
+    """An ingress iface forcing a vni: both paths rewrite it."""
+    burst = mk_burst(120)
+    res = []
+    for fastp in (True, False):
+        loop, sw, n1, n2, out, l2out = mk_world(fastp)
+        try:
+            # pre-register the senders as ifaces forced into vni 101
+            remotes = {(b[1], b[2]) for b in burst}
+            def reg():
+                for r in remotes:
+                    iface = sw._resolve_remote(r)
+                    iface.local_side_vni = 101
+            loop.call_sync(reg, timeout=30)
+            # frames claim vni 999 but must enter vpc 101 anyway
+            re_burst = []
+            for data, ip, port in burst:
+                pkt = P.Vxlan.parse(data)
+                re_burst.append((P.Vxlan(999, pkt.ether).to_bytes(),
+                                 ip, port))
+            loop.call_sync(lambda: sw._input_batch(re_burst), timeout=120)
+            time.sleep(0.05)
+            res.append(_norm(out.frames))
+        finally:
+            sw.stop()
+            loop.close()
+    assert res[0] == res[1]
+    assert len(res[0]) > 0
+
+
+def test_fastpath_incremental_checksum_exact():
+    """RFC 1624 incremental update == full recompute for every ttl."""
+    from vproxy_tpu.vswitch.fastpath import (_IP_CSUM, _IP_TTL)
+    for ttl in (2, 3, 64, 128, 255):
+        ip = P.Ipv4(src=bytes([10, 1, 2, 3]), dst=bytes([10, 2, 3, 4]),
+                    proto=17, payload=b"x" * 9, ttl=ttl)
+        raw = bytearray(b"\x00" * 22 + ip.to_bytes())
+        c = (raw[_IP_CSUM] << 8) | raw[_IP_CSUM + 1]
+        raw[_IP_TTL] -= 1
+        x = (c ^ 0xFFFF) + 0xFEFF
+        x = (x & 0xFFFF) + (x >> 16)
+        x = (x & 0xFFFF) + (x >> 16)
+        c2 = x ^ 0xFFFF
+        hdr = bytearray(raw[22:42])
+        hdr[10:12] = b"\x00\x00"
+        assert P.checksum(bytes(hdr)) == c2, ttl

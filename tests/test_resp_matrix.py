@@ -1,0 +1,248 @@
+"""Resource-CRUD matrix through the RESP controller.
+
+Port of the reference CI suite's core discipline (test/ci/CI.java:
+225-291): every resource type is driven through add -> list ->
+list-detail -> (update) -> remove over the REAL redis protocol against
+a live resp-controller, dependencies created first and torn down in
+reverse; then the surviving config round-trips through shutdown
+persistence (config-as-command-log replay). This is the public-API
+conformance suite SURVEY §4 calls for.
+"""
+import os
+import socket
+import subprocess
+
+import pytest
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import TYPES, Command
+from vproxy_tpu.control import persist
+
+
+class RespClient:
+    """Minimal redis-protocol client speaking to the resp-controller."""
+
+    def __init__(self, port, password=None):
+        self.s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        self.s.settimeout(5)
+        self.buf = b""
+        if password is not None:
+            assert self.cmd("AUTH", password) == "OK"
+
+    def close(self):
+        self.s.close()
+
+    def cmd(self, *parts):
+        enc = f"*{len(parts)}\r\n".encode()
+        for p in parts:
+            b = p.encode() if isinstance(p, str) else p
+            enc += f"${len(b)}\r\n".encode() + b + b"\r\n"
+        self.s.sendall(enc)
+        return self._read()
+
+    def _line(self):
+        while b"\r\n" not in self.buf:
+            d = self.s.recv(65536)
+            if not d:
+                raise OSError("closed")
+            self.buf += d
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _take(self, n):
+        while len(self.buf) < n + 2:
+            d = self.s.recv(65536)
+            if not d:
+                raise OSError("closed")
+            self.buf += d
+        out, self.buf = self.buf[:n], self.buf[n + 2:]
+        return out
+
+    def _read(self):
+        line = self._line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise AssertionError(f"RESP error: {rest.decode()}")
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n < 0 else self._take(n).decode()
+        if t == b"*":
+            n = int(rest)
+            return None if n < 0 else [self._read() for _ in range(n)]
+        raise AssertionError(f"bad RESP type {line!r}")
+
+
+@pytest.fixture(scope="module")
+def matrix_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("matrix-certs")
+    cert, key = d / "m.crt", d / "m.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "2",
+         "-subj", "/CN=m.example.com"],
+        check=True, capture_output=True)
+    return str(cert), str(key)
+
+
+@pytest.fixture
+def resp(tmp_path, matrix_cert):
+    app = Application.create(workers=1)
+    Command.execute(app, "add resp-controller ctl address 127.0.0.1:0 "
+                         "password p@ss")
+    app._matrix_cert = matrix_cert
+    c = RespClient(app.resp_controllers["ctl"].bind_port, password="p@ss")
+    yield app, c, tmp_path
+    c.close()
+    app.close()
+
+
+def run(c: RespClient, line: str):
+    return c.cmd(*line.split())
+
+
+# (add-line, detail-substr, update-line or None, remove-line). Ordered
+# by dependency; teardown runs in reverse. Types whose lifecycle is
+# bound to another resource (event-loop inside a group, server inside a
+# server-group, ...) are exercised through their owning context exactly
+# like CI.java does.
+MATRIX = [
+    ("add event-loop-group elg0", None, None,
+     "remove event-loop-group elg0"),
+    ("add event-loop el0 to event-loop-group elg0", None, None,
+     "remove event-loop el0 from event-loop-group elg0"),
+    ("add upstream ups0", None, None, "remove upstream ups0"),
+    ("add server-group sg0 timeout 500 period 200 up 1 down 3 method wrr "
+     "event-loop-group elg0", "wrr",
+     "update server-group sg0 timeout 800 period 400 up 2 down 2",
+     "remove server-group sg0"),
+    ("add server svr0 to server-group sg0 address 127.0.0.1:19999 "
+     "weight 5", "127.0.0.1:19999",
+     "update server svr0 in server-group sg0 weight 8",
+     "remove server svr0 from server-group sg0"),
+    ("add server-group sg0 to upstream ups0 weight 7", "sg0",
+     "update server-group sg0 in upstream ups0 weight 9",
+     "remove server-group sg0 from upstream ups0"),
+    ("add security-group secg0 default allow", "allow",
+     "update security-group secg0 default deny",
+     "remove security-group secg0"),
+    ("add security-group-rule r0 to security-group secg0 network "
+     "10.0.0.0/8 protocol TCP port-range 1,1024 default allow", "10.0.0.0",
+     None, "remove security-group-rule r0 from security-group secg0"),
+    ("add cert-key ck0 cert {CERT} key {KEY}", None, None,
+     "remove cert-key ck0"),
+    ("add tcp-lb lb0 address 127.0.0.1:0 upstream ups0 timeout 4000",
+     "ups0", "update tcp-lb lb0 timeout 9000", "remove tcp-lb lb0"),
+    ("add socks5-server s5 address 127.0.0.1:0 upstream ups0", "ups0",
+     "update socks5-server s5 timeout 9000", "remove socks5-server s5"),
+    ("add dns-server dns0 address 127.0.0.1:0 upstream ups0 ttl 5",
+     "ups0", "update dns-server dns0 ttl 9", "remove dns-server dns0"),
+    ("add switch sw0 address 127.0.0.1:0", "127.0.0.1", None,
+     "remove switch sw0"),
+    ("add vpc 7 to switch sw0 v4network 172.16.0.0/16", "172.16",
+     None, "remove vpc 7 from switch sw0"),
+    ("add ip 172.16.0.21 to vpc 7 in switch sw0", "172.16.0.21", None,
+     "remove ip 172.16.0.21 from vpc 7 in switch sw0"),
+    ("add route rt0 to vpc 7 in switch sw0 network 172.17.0.0/16 vni 7",
+     "172.17", None, "remove route rt0 from vpc 7 in switch sw0"),
+    ("add user u001 to switch sw0 password pw1 vni 7", None, None,
+     "remove user u001 from switch sw0"),
+    ("add user-client uc1 to switch sw0 password pw1 vni 7 address "
+     "127.0.0.1:18472", None, None,
+     "remove user-client uc1 from switch sw0"),
+    # switch-to-switch link (the reference's remote-switch resource is
+    # spelled `add switch <alias> to switch <sw>` — SwitchHandle)
+    ("add switch rsw0 to switch sw0 address 127.0.0.1:18473",
+     None, None, "remove switch rsw0 from switch sw0"),
+]
+
+
+def test_resp_crud_matrix(resp):
+    """Every row: create -> visible in list + list-detail -> update ->
+    still consistent; save the full world; teardown in reverse (each
+    visibly gone); then replay the saved command log into a FRESH app
+    and check the world came back (shutdown persistence contract)."""
+    app, c, tmp = resp
+    cert, key = app._matrix_cert
+    created = []
+    for add, detail_sub, update, remove in MATRIX:
+        add = add.replace("{CERT}", cert).replace("{KEY}", key)
+        assert run(c, add) == "OK", add
+        rtype = add.split()[1]
+        alias = add.split()[2]
+        ctx = add.split(" to ", 1)[1] if " to " in add else None
+        if rtype == "switch" and ctx:  # remote link: listed as an iface
+            rtype_q, match = "iface", f"remote:{alias}"
+        else:
+            rtype_q, match = rtype, alias
+        lst = run(c, f"list {rtype_q}" + (f" in {ctx}" if ctx else ""))
+        assert any(match in str(x) for x in lst), (add, lst)
+        det = run(c, f"list-detail {rtype_q}"
+                  + (f" in {ctx}" if ctx else ""))
+        assert any(match in str(x) for x in det), (add, det)
+        if detail_sub:
+            assert any(detail_sub in str(x) for x in det), (detail_sub, det)
+        if update:
+            assert run(c, update) == "OK", update
+            det2 = run(c, f"list-detail {rtype_q}"
+                       + (f" in {ctx}" if ctx else ""))
+            assert any(match in str(x) for x in det2)
+        created.append((add, remove))
+
+    # save the full world as a command log while everything is alive
+    cfg = os.path.join(str(tmp), "vproxy.last")
+    persist.save(app, cfg)
+
+    # teardown strictly in reverse dependency order, each visibly gone
+    for add, remove in reversed(created):
+        assert run(c, remove) == "OK", remove
+        rtype = remove.split()[1]
+        alias = remove.split()[2]
+        ctx = remove.split(" from ", 1)[1] if " from " in remove else None
+        if rtype == "switch" and ctx:
+            lst = run(c, f"list iface in {ctx}")
+            assert not any(f"remote:{alias}" in str(x)
+                           for x in (lst or [])), (remove, lst)
+            continue
+        lst = run(c, f"list {rtype}" + (f" in {ctx}" if ctx else ""))
+        assert not any(str(x) == alias or str(x).startswith(alias + " ")
+                       for x in (lst or [])), (remove, lst)
+
+    # replay the saved log into a fresh app (listeners are free now)
+    app2 = Application.create(workers=1)
+    try:
+        persist.load(app2, cfg)
+        assert set(app2.upstreams) == {"ups0"}
+        assert set(app2.tcp_lbs) == {"lb0"}
+        assert set(app2.socks5_servers) == {"s5"}
+        assert set(app2.dns_servers) == {"dns0"}
+        assert set(app2.switches) == {"sw0"}
+        sw2 = app2.switches["sw0"]
+        assert 7 in sw2.networks
+        assert sw2.users  # u001 came back
+        assert any(r.alias == "rt0" for r in sw2.networks[7].routes.rules)
+        assert app2.tcp_lbs["lb0"].timeout_ms == 9000
+    finally:
+        app2.close()
+
+
+def test_resp_matrix_covers_creatable_inventory():
+    """The matrix must keep covering every RESP-creatable type: if a new
+    resource type lands in TYPES without a matrix row, this fails."""
+    covered = {row[0].split()[1] for row in MATRIX}
+    # queried/virtual or attach-only resources have no standalone
+    # create form (they are listed through their parents or created
+    # implicitly); controllers are exercised in test_control_extras
+    uncreatable = {
+        "server-sock", "session", "connection", "bytes-in", "bytes-out",
+        "accepted-conn-count", "dns-cache", "resolver", "proxy", "iface",
+        "arp", "conntrack", "config", "auto-lb", "resp-controller",
+        "http-controller", "docker-network-plugin-controller", "tap",
+        "xdp", "vlan-adaptor",
+    }
+    for t in set(TYPES.values()):
+        assert t in covered or t in uncreatable, \
+            f"resource type {t} not covered by the RESP CRUD matrix"

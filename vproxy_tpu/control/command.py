@@ -614,6 +614,34 @@ def _h_socks5(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for socks5-server")
 
 
+def _mk_resource_resolver(app: Application):
+    """`<alias>.<type>.vproxy.local` -> the live resource's bind address
+    (the resource-introspection arm of DNSServer._run_internal). Types:
+    tcp-lb, socks5-server, dns-server, switch."""
+    from ..utils.ip import parse_ip as _pip
+
+    def resolve(sub: str):
+        if "." not in sub:
+            return None
+        alias, rtype = sub.split(".", 1)
+        holder = {"tcp-lb": app.tcp_lbs,
+                  "socks5-server": app.socks5_servers,
+                  "dns-server": app.dns_servers,
+                  "switch": app.switches}.get(rtype)
+        res = holder.get(alias) if holder is not None else None
+        if res is None:
+            return None
+        ip = getattr(res, "bind_ip", None)
+        if ip is None:
+            return None
+        try:
+            return _pip(ip)
+        except (OSError, ValueError):
+            return None
+
+    return resolve
+
+
 def _h_dns(app: Application, c: Command):
     if c.action == "add":
         if c.alias in app.dns_servers:
@@ -623,7 +651,8 @@ def _h_dns(app: Application, c: Command):
         elg = _opt_elg(app, c, "elg", app.worker_elg)
         secg = _opt_secg(app, c)
         d = DNSServer(c.alias, elg.next(), ip, port, ups, elg=elg,
-                      ttl=int(c.params.get("ttl", 0)), security_group=secg)
+                      ttl=int(c.params.get("ttl", 0)), security_group=secg,
+                      resource_resolver=_mk_resource_resolver(app))
         d.start()
         app.dns_servers[c.alias] = d
         return "OK"

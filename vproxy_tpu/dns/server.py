@@ -3,10 +3,19 @@
 Parity: core dns/DNSServer.java. Lookup order per qname
 (DNSServer.java:116-195): hosts map -> rrsets (an Upstream searched with
 Hint.ofHost(domain) — the classify engine) -> IP-literal echo ->
-recursive upstream via DNSClient. A/AAAA answers pick a HEALTHY backend
-via the matched group's nextIPv4/nextIPv6 (DNS answers load-balance);
-SRV lists all healthy server handles with weights. Queries are gated by
-a SecurityGroup (UDP protocol).
+`*.vproxy.local` introspection (DNSServer.java:150-157 + runInternal
+:339-349: who.am.i answers the requester's own address, who.are.you the
+server's local address facing them; plus the resource extension below)
+-> recursive upstream via DNSClient. A/AAAA answers pick a HEALTHY
+backend via the matched group's nextIPv4/nextIPv6 (DNS answers
+load-balance); SRV lists all healthy server handles with weights.
+Queries are gated by a SecurityGroup (UDP protocol).
+
+Resource introspection extension: `resource_resolver` (installed by the
+control plane, control/command.py) maps the sub-domain left of
+`.vproxy.local` to a live resource address — e.g. `web.tcp-lb
+.vproxy.local` answers tcp-lb `web`'s bind address from the running
+Application state.
 """
 from __future__ import annotations
 
@@ -30,7 +39,8 @@ class DNSServer:
                  bind_port: int, rrsets: Upstream, ttl: int = 0,
                  security_group: Optional[SecurityGroup] = None,
                  recursive_client: Optional[DNSClient] = None,
-                 hosts: Optional[dict[str, bytes]] = None, elg=None):
+                 hosts: Optional[dict[str, bytes]] = None, elg=None,
+                 resource_resolver=None):
         self.alias = alias
         self.loop = loop
         self.bind_ip = bind_ip
@@ -40,6 +50,9 @@ class DNSServer:
         self.security_group = security_group or SecurityGroup.allow_all()
         self.recursive = recursive_client
         self.hosts = hosts or {}
+        # optional `(subdomain) -> Optional[bytes addr]` hook answering
+        # `<subdomain>.vproxy.local` from live resource state
+        self.resource_resolver = resource_resolver
         self._fd: Optional[int] = None
         self.elg = elg  # attach target for loop-death re-homing
         self.started = False
@@ -177,6 +190,19 @@ class DNSServer:
                             answers.append(self._addr_record(q.qname, addr))
                         self._handle_q(req, ip, port, qs, i + 1, answers)
                         return
+                    if domain.endswith(".vproxy.local"):
+                        # DNSServer.java:150-157: answered from internal
+                        # state, never recursed out; family gated by the
+                        # question type like the IP-literal arm above
+                        for a in self._run_internal(
+                                domain[: -len(".vproxy.local")], ip):
+                            if ((q.qtype == P.A and len(a) == 4)
+                                    or (q.qtype == P.AAAA and len(a) == 16)
+                                    or q.qtype in (P.SRV, P.ANY)):
+                                answers.append(
+                                    self._addr_record(q.qname, a))
+                        self._handle_q(req, ip, port, qs, i + 1, answers)
+                        return
                     self._run_recursive(req, ip, port)
                     return
                 self._answer_group(q, gh, ip, answers)
@@ -201,6 +227,33 @@ class DNSServer:
             conn = gh.group.next(parse_ip(ip), fam)
             if conn is not None:  # no healthy server: empty answer section
                 answers.append(self._addr_record(q.qname, parse_ip(conn.ip)))
+
+    def _run_internal(self, sub: str, ip: str) -> list[bytes]:
+        """`<sub>.vproxy.local` answers (DNSServer.runInternal
+        :339-349): who.am.i = the requester's address; who.are.you =
+        this server's local address facing them; anything else consults
+        the control plane's resource resolver."""
+        if sub == "who.am.i":
+            return [parse_ip(ip)]
+        if sub == "who.are.you":
+            local = self.bind_ip
+            if local in ("0.0.0.0", "::"):
+                import socket
+                try:  # routed local address toward the requester
+                    s = socket.socket(socket.AF_INET6 if ":" in ip
+                                      else socket.AF_INET,
+                                      socket.SOCK_DGRAM)
+                    s.connect((ip, 53))
+                    local = s.getsockname()[0]
+                    s.close()
+                except OSError:
+                    return []
+            return [parse_ip(local)]
+        if self.resource_resolver is not None:
+            a = self.resource_resolver(sub)
+            if a is not None:
+                return [a]
+        return []
 
     def _addr_record(self, qname: str, addr: bytes) -> P.Record:
         return P.Record(name=qname, rtype=P.A if len(addr) == 4 else P.AAAA,

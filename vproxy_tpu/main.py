@@ -52,6 +52,18 @@ def main(argv: list[str] | None = None) -> int:
     from .utils.oom import install as install_oom
     install_oom()
 
+    # multi-host bring-up BEFORE any device touch: when
+    # VPROXY_TPU_DIST_COORD/_NPROC/_PROCID are set, join the
+    # jax.distributed job so every matcher mesh can span hosts
+    # (parallel/mesh.py — tables replicated per host over DCN, rules
+    # sharded within host over ICI). No-op when unset.
+    from .parallel.mesh import init_distributed
+    if init_distributed():
+        import jax
+        print(f"joined distributed job: process "
+              f"{jax.process_index()}/{jax.process_count()}, "
+              f"{len(jax.devices())} global devices")
+
     # deployable apps (reference -Deploy=...): first arg selects the app
     if argv and argv[0].lower() in ("simple", "helloworld", "daemon",
                                     "kcptun", "websocks"):

@@ -6,13 +6,28 @@ tensor-parallel analog — each chip holds a slice of every table and the
 argmax/min reduction rides ICI collectives inserted by the SPMD
 partitioner), while query micro-batches shard over "batch" (the
 data-parallel analog — the per-core event-loop sharding of
-app/Application.java:90-105 maps to batch shards). A single chip
-overflows neither HBM nor step-rate for the reference's scale, so the
-mesh exists for headroom and for multi-host DCN deployments where the
-control plane replicates tables per host.
+app/Application.java:90-105 maps to batch shards).
+
+Multi-host: init_distributed() brings up jax.distributed (the analog of
+the reference's cross-host fabric, RemoteSwitchIface.java — but over
+the accelerator DCN, not VXLAN), after which jax.devices() is GLOBAL
+and make_mesh(hosts=N) lays out a (host, batch, rules) mesh where
+
+* tables are REPLICATED across the "host" axis (each host holds the
+  full rule set — updates are control-plane broadcasts over DCN),
+* the "rules" shards stay WITHIN a host, so the winner pmax/pmin
+  reductions ride ICI only,
+* query batches shard over (host, batch): each host classifies its own
+  accepted connections; no per-query DCN traffic at all.
+
+put()/to_local() abstract single- vs multi-process array creation so
+the same engine code runs on one process (device_put) or many
+(make_array_from_process_local_data, every process contributing its
+local batch slice).
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -20,12 +35,85 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_mesh(n_devices: Optional[int] = None, batch: int = 1) -> Mesh:
-    """Mesh with axes (batch, rules); rules gets the remaining devices."""
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """jax.distributed multi-host bring-up; reads VPROXY_TPU_DIST_COORD
+    (host:port), VPROXY_TPU_DIST_NPROC, VPROXY_TPU_DIST_PROCID when the
+    args are absent. Returns False (no-op) when not configured —
+    single-host deployments never pay for it. Must run before the first
+    device use (main.py boots it first thing)."""
+    coordinator = coordinator or os.environ.get("VPROXY_TPU_DIST_COORD")
+    if num_processes is None:
+        num_processes = int(os.environ.get("VPROXY_TPU_DIST_NPROC", "0")
+                            or 0)
+    if process_id is None:
+        process_id = int(os.environ.get("VPROXY_TPU_DIST_PROCID", "-1")
+                         or -1)
+    if not coordinator or num_processes <= 1 or process_id < 0:
+        return False
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def make_mesh(n_devices: Optional[int] = None, batch: int = 1,
+              hosts: int = 1) -> Mesh:
+    """Mesh with axes (batch, rules) — or (host, batch, rules) when
+    hosts > 1; "rules" gets the remaining devices. With hosts equal to
+    jax.process_count() the host axis follows process boundaries
+    (jax.devices() orders all of process 0's devices first)."""
     devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
     n = len(devs)
-    assert n % batch == 0, (n, batch)
+    assert n % (batch * hosts) == 0, (n, batch, hosts)
+    if hosts > 1:
+        return Mesh(np.array(devs).reshape(hosts, batch,
+                                           n // (batch * hosts)),
+                    ("host", "batch", "rules"))
     return Mesh(np.array(devs).reshape(batch, n // batch), ("batch", "rules"))
+
+
+def batch_axes(mesh: Mesh) -> tuple:
+    """Every mesh axis except "rules" carries query batches."""
+    return tuple(a for a in mesh.axis_names if a != "rules")
+
+
+def query_shards(mesh: Mesh) -> int:
+    """Total batch-axis size (the pad multiple for query batches)."""
+    n = 1
+    for a in batch_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
+
+def put(mesh: Mesh, spec: P, local: np.ndarray):
+    """Create a global array from this process's local data: device_put
+    single-process; make_array_from_process_local_data when the mesh
+    spans processes (each host contributes its own batch slice; table
+    arrays — replicated or rules-sharded-within-host — pass the full
+    array since every local shard is derivable from it)."""
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sh, local)
+    return jax.device_put(local, sh)
+
+
+def to_local(arr) -> np.ndarray:
+    """This process's contiguous slice of a batch-sharded output (the
+    whole array on a single process). Assumes the leading dim is the
+    batch axis and this process's shards are contiguous in it (true for
+    (host, batch, rules) meshes where host follows process order). An
+    output replicated over the in-host "rules" axis has one shard COPY
+    per device — dedupe by index so each slice contributes once."""
+    if jax.process_count() <= 1:
+        return np.asarray(arr)
+    seen = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        if start not in seen:
+            seen[start] = s.data
+    return np.concatenate(
+        [np.asarray(seen[k]) for k in sorted(seen)])
 
 
 # PartitionSpecs per table key: 2-D matmul weights shard on their rule
@@ -60,16 +148,17 @@ def shard_cidr_table(table: dict, mesh: Mesh) -> dict:
 
 def shard_hint_queries(q: dict, mesh: Mesh) -> dict:
     return {k: jax.device_put(v, NamedSharding(mesh, _HINT_Q_SPECS[k]))
-            for k, v in q.items()}
+            for k, v in q.items()}  # dense experimental path: 2-axis mesh
 
 
 def shard_addr_queries(addr: np.ndarray, fam: np.ndarray, mesh: Mesh,
                        port: Optional[np.ndarray] = None):
-    a = jax.device_put(addr, NamedSharding(mesh, P("batch", None)))
-    f = jax.device_put(fam, NamedSharding(mesh, P("batch")))
+    ba = batch_axes(mesh)
+    a = put(mesh, P(ba, None), addr)
+    f = put(mesh, P(ba), fam)
     if port is None:
         return a, f, None
-    return a, f, jax.device_put(port, NamedSharding(mesh, P("batch")))
+    return a, f, put(mesh, P(ba), port)
 
 
 # ------------------------------------------------- hash-path (production)
@@ -90,17 +179,18 @@ def _leading_rules_spec(arrays: dict) -> dict:
 
 
 def shard_hash_table(stab, mesh: Mesh) -> dict:
-    """device_put a ShardedHashTable's stacked arrays over the mesh."""
+    """Ship a ShardedHashTable's stacked arrays over the mesh (tables
+    replicate across host/batch axes; multi-process hosts each pass the
+    identical full array)."""
     specs = _leading_rules_spec(stab.arrays)
-    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-            for k, v in stab.arrays.items()}
+    return {k: put(mesh, specs[k], v) for k, v in stab.arrays.items()}
 
 
 def shard_hint_queries_sharded(q: dict, mesh: Mesh) -> dict:
     """Stacked per-shard hint encodings: (rules, batch, ...) sharded."""
-    return {k: jax.device_put(
-        v, NamedSharding(mesh, P("rules", "batch", *([None] * (v.ndim - 2)))))
-        for k, v in q.items()}
+    ba = batch_axes(mesh)
+    return {k: put(mesh, P("rules", ba, *([None] * (v.ndim - 2))), v)
+            for k, v in q.items()}
 
 
 def _shard_map(body, mesh, in_specs, out_specs):
@@ -142,14 +232,15 @@ def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
         return jnp.where(best_lvl > 0, gmin, -1)
 
     # ndim values are the STACKED ndims (leading shard axis included)
+    ba = batch_axes(mesh)
     in_specs = (
         {k: P("rules", *([None] * (nd - 1)))
          for k, nd in table_keys_ndim.items()},
-        {k: P("rules", "batch", *([None] * (nd - 2)))
+        {k: P("rules", ba, *([None] * (nd - 2)))
          for k, nd in query_keys_ndim.items()},
         P(),
     )
-    return jax.jit(_shard_map(body, mesh, in_specs, P("batch")))
+    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)))
 
 
 def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
@@ -173,7 +264,8 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
             g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
                              "rules")
             return jnp.where(g < BIG, g, -1)
-        q_specs = (P("batch", None), P("batch"), P("batch"), P())
+        ba = batch_axes(mesh)
+        q_specs = (P(ba, None), P(ba), P(ba), P())
     else:
         def body(t, a16, fam, shard_size):
             sid = jax.lax.axis_index("rules").astype(jnp.int32)
@@ -182,13 +274,14 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
             g = jax.lax.pmin(jnp.where(li >= 0, sid * shard_size + li, BIG),
                              "rules")
             return jnp.where(g < BIG, g, -1)
-        q_specs = (P("batch", None), P("batch"), P())
+        ba = batch_axes(mesh)
+        q_specs = (P(ba, None), P(ba), P())
 
     in_specs = (
         {k: P("rules", *([None] * (nd - 1)))  # stacked ndims
          for k, nd in table_keys_ndim.items()},
     ) + q_specs
-    return jax.jit(_shard_map(body, mesh, in_specs, P("batch")))
+    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)))
 
 
 def make_sharded_classify(mesh: Mesh, hint_stab, route_stab, acl_stab,
@@ -233,14 +326,15 @@ def make_sharded_classify(mesh: Mesh, hint_stab, route_stab, acl_stab,
         a_global = cidr_global(at, port, a_size)
         return jnp.stack([h_global, r_global, a_global], axis=1)
 
+    ba = batch_axes(mesh)
     in_specs = (
         _leading_rules_spec(hint_stab.arrays),
         _leading_rules_spec(route_stab.arrays),
         _leading_rules_spec(acl_stab.arrays),
-        {k: P("rules", "batch", *([None] * (v.ndim - 2)))
+        {k: P("rules", ba, *([None] * (v.ndim - 2)))
          for k, v in example_hq.items()},
-        P("batch", None), P("batch"), P("batch"),
+        P(ba, None), P(ba), P(ba),
     )
     fn = shard_map(body, mesh=mesh, in_specs=in_specs,
-                   out_specs=P("batch", None))
+                   out_specs=P(ba, None))
     return jax.jit(fn)

@@ -182,8 +182,10 @@ class HintMatcher:
         # small tables answer lone queries with the linear oracle (the
         # same crossover match_one uses), so the index build — a second
         # O(rules) bucket construction on the update path — only pays
-        # for itself past SMALL_TABLE
-        if self.backend != "host" and len(self._rules) > SMALL_TABLE:
+        # for itself past SMALL_TABLE. Built for EVERY backend: the
+        # inline accept path serves host-backend matchers too, and a
+        # big table must never put an O(rules) scan on an event loop
+        if len(self._rules) > SMALL_TABLE:
             from .index import HintIndex
             idx = HintIndex(self._rules)
         self._pub = (self._tab, self._dev, list(self._rules), self._payload,
@@ -258,8 +260,9 @@ class HintMatcher:
             return idx
         if self.backend in ("jax-sharded", "jax-fp-sharded"):
             from ..parallel import mesh as M
+            from ..parallel.mesh import query_shards
             n = len(hints)
-            cap = pad_batch(n, self._mesh.shape["batch"])
+            cap = pad_batch(n, query_shards(self._mesh))
             padded = list(hints) + [Hint()] * (cap - n)
             if self.backend == "jax-fp-sharded":
                 from ..ops import fphash as F
@@ -274,7 +277,9 @@ class HintMatcher:
                     self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
                     {k: v.ndim for k, v in q.items()}, kernel=kernel)
             out = self._fn(dev, qd, np.int32(tab.shard_size))
-            return np.asarray(out)[:n]
+            # to_local: this process's slice on a multi-process mesh,
+            # plain np.asarray single-process
+            return M.to_local(out)[:n]
         q = T.encode_hints(hints)
         idx, _ = hint_match_jit(
             dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
@@ -350,7 +355,7 @@ class CidrMatcher:
             tab = T.compile_cidr_rules(self._nets, cap=cap, acl=self._acl)
             self._dev = _to_device(table_arrays(tab))
         idx = None
-        if self.backend != "host" and len(self._nets) > SMALL_TABLE:
+        if len(self._nets) > SMALL_TABLE:  # every backend: see HintMatcher
             from .index import CidrIndex
             idx = CidrIndex(self._nets, acl=self._acl)
         self._pub = (self._dev, list(self._nets),
@@ -437,8 +442,9 @@ class CidrMatcher:
                           fam: np.ndarray, p: Optional[np.ndarray]):
         from ..parallel import mesh as M
         dev, tab = snap[0], snap[4]
+        from ..parallel.mesh import query_shards
         n = a16.shape[0]
-        cap = pad_batch(n, self._mesh.shape["batch"])
+        cap = pad_batch(n, query_shards(self._mesh))
         if cap != n:
             a16 = np.concatenate(
                 [a16, np.zeros((cap - n,) + a16.shape[1:], a16.dtype)])
@@ -459,4 +465,4 @@ class CidrMatcher:
         size = np.int32(tab.shard_size)
         out = fn(dev, a16d, famd, pd, size) if with_port \
             else fn(dev, a16d, famd, size)
-        return np.asarray(out)[:n]
+        return M.to_local(out)[:n]

@@ -328,7 +328,7 @@ class ClassifyService:
             except MemoryError:
                 raise
             except Exception as e:
-                self.stats.failovers += 1
+                self.stats.bump("failovers")
                 self._device_down_until = time.monotonic() + self.retry_s
                 _log.alert(f"device probe failed ({e!r}); device marked "
                            f"down for {self.retry_s:.0f}s")

@@ -29,6 +29,12 @@ class Iface:
     def send_vxlan(self, sw, pkt: Vxlan) -> None:
         raise NotImplementedError
 
+    # send_vxlan_raw(sw, data) — OPTIONAL: emit an already-serialized
+    # vxlan datagram without re-parsing (the burst fast path's egress,
+    # vswitch/fastpath.py). Ifaces that must transform the frame
+    # (encrypting user tunnels) simply don't define it and the fast
+    # path routes their traffic through the object pipeline.
+
     def close(self) -> None: ...
 
 
@@ -42,6 +48,9 @@ class BareVXLanIface(Iface):
     def send_vxlan(self, sw, pkt: Vxlan) -> None:
         sw.send_udp(pkt.to_bytes(), self.remote)
 
+    def send_vxlan_raw(self, sw, data: bytes) -> None:
+        sw.send_udp(data, self.remote)
+
 
 class RemoteSwitchIface(Iface):
     """Link to another vproxy-style switch (plain VXLAN, any vni)."""
@@ -54,6 +63,9 @@ class RemoteSwitchIface(Iface):
 
     def send_vxlan(self, sw, pkt: Vxlan) -> None:
         sw.send_udp(pkt.to_bytes(), self.remote)
+
+    def send_vxlan_raw(self, sw, data: bytes) -> None:
+        sw.send_udp(data, self.remote)
 
 
 class UserIface(Iface):
@@ -159,6 +171,12 @@ class TapIface(Iface):
     def send_vxlan(self, sw, pkt: Vxlan) -> None:
         try:
             os.write(self.fd, pkt.ether.to_bytes())
+        except OSError:
+            pass
+
+    def send_vxlan_raw(self, sw, data: bytes) -> None:
+        try:
+            os.write(self.fd, data[8:])  # strip the vxlan header
         except OSError:
             pass
 

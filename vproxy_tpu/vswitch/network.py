@@ -23,14 +23,23 @@ ARP_TABLE_TIMEOUT = 4 * 3600_000
 
 
 class MacTable:
-    """mac -> iface, expiring entries after timeout ms."""
+    """mac -> iface, expiring entries after timeout ms.
+
+    `version` counts MAPPING changes (new mac, mac moved to another
+    iface, removals) — NOT timestamp refreshes — so the burst fast
+    path's vectorized view (vswitch/fastpath.py) stays valid across
+    steady-state re-learns and rebuilds only when the topology moves."""
 
     def __init__(self, timeout_ms: int = MAC_TABLE_TIMEOUT):
         self.timeout_ms = timeout_ms
+        self.version = 0
         self._e: dict[bytes, tuple[object, float]] = {}
 
     def record(self, mac: bytes, iface) -> None:
+        old = self._e.get(mac)
         self._e[mac] = (iface, time.monotonic())
+        if old is None or old[0] is not iface:
+            self.version += 1
 
     def lookup(self, mac: bytes):
         ent = self._e.get(mac)
@@ -39,6 +48,7 @@ class MacTable:
         iface, ts = ent
         if (time.monotonic() - ts) * 1000 > self.timeout_ms:
             del self._e[mac]
+            self.version += 1
             return None
         return iface
 
@@ -46,12 +56,14 @@ class MacTable:
         for mac, (i, _) in list(self._e.items()):
             if i is iface:
                 del self._e[mac]
+                self.version += 1
 
     def expire(self) -> None:
         now = time.monotonic()
         for mac, (_, ts) in list(self._e.items()):
             if (now - ts) * 1000 > self.timeout_ms:
                 del self._e[mac]
+                self.version += 1
 
     def entries(self) -> list[tuple[str, object]]:
         self.expire()
@@ -59,14 +71,19 @@ class MacTable:
 
 
 class ArpTable:
-    """ip(bytes, canonical 4/16) -> mac, with TTL."""
+    """ip(bytes, canonical 4/16) -> mac, with TTL. `version` counts
+    mapping changes only (see MacTable.version)."""
 
     def __init__(self, timeout_ms: int = ARP_TABLE_TIMEOUT):
         self.timeout_ms = timeout_ms
+        self.version = 0
         self._e: dict[bytes, tuple[bytes, float]] = {}
 
     def record(self, ip: bytes, mac: bytes) -> None:
+        old = self._e.get(ip)
         self._e[ip] = (mac, time.monotonic())
+        if old is None or old[0] != mac:
+            self.version += 1
 
     def lookup(self, ip: bytes) -> Optional[bytes]:
         ent = self._e.get(ip)
@@ -75,6 +92,7 @@ class ArpTable:
         mac, ts = ent
         if (time.monotonic() - ts) * 1000 > self.timeout_ms:
             del self._e[ip]
+            self.version += 1
             return None
         return mac
 
@@ -83,6 +101,7 @@ class ArpTable:
         for ip, (_, ts) in list(self._e.items()):
             if (now - ts) * 1000 > self.timeout_ms:
                 del self._e[ip]
+                self.version += 1
 
     def entries(self) -> list[tuple[str, str]]:
         self.expire()
@@ -96,6 +115,7 @@ class SyntheticIpHolder:
     _MISS = object()
 
     def __init__(self):
+        self.version = 0
         self._ips: dict[bytes, bytes] = {}  # ip -> mac
         # first_in runs once per ROUTED PACKET (gateway source pick);
         # memoized per network, invalidated on any mutation. _by_mac is
@@ -112,12 +132,14 @@ class SyntheticIpHolder:
         self._ips[ip] = mac
         self._by_mac.setdefault(mac, ip)
         self._first_cache.clear()
+        self.version += 1
 
     def remove(self, ip: bytes) -> None:
         mac = self._ips.pop(ip, None)
         if mac is not None:
             self._unindex_mac(ip, mac)
         self._first_cache.clear()
+        self.version += 1
 
     def _unindex_mac(self, ip: bytes, mac: bytes) -> None:
         if self._by_mac.get(mac) == ip:

@@ -78,10 +78,21 @@ class Switch:
         # (Shutdown.currentConfig serializes users with their passwords)
         self.users: dict[str, tuple[bytes, int, str]] = {}
         self.ifaces: dict = {}  # key -> (Iface, last_active_ts)
+        # bumped on any registry mutation; the fast path's remote cache
+        # (vswitch/fastpath.py) keys its validity on it
+        self._reg_version = 0
         # remote (ip, port) -> registry key, so the per-datagram sender
         # lookup is O(1) instead of a scan over every registered iface
         self._remote_idx: dict[tuple[str, int], tuple] = {}
         self.stack = NetworkStack(self)
+        # vectorized burst fast path (vswitch/fastpath.py); slow-path
+        # leftovers keep the object pipeline. VPROXY_TPU_SWITCH_FASTPATH=0
+        # forces the pure object path (A/B + debugging escape hatch).
+        import os as _os
+        self.fastpath = None
+        if _os.environ.get("VPROXY_TPU_SWITCH_FASTPATH", "1") != "0":
+            from .fastpath import SwitchFastPath
+            self.fastpath = SwitchFastPath(self)
         self._fd: Optional[int] = None
         self._sweeper = None
         self.started = False
@@ -129,6 +140,7 @@ class Switch:
         for key, (iface, ts) in list(self.ifaces.items()):
             if isinstance(iface, TapIface):
                 del self.ifaces[key]
+                self._reg_version += 1
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -180,6 +192,7 @@ class Switch:
             for iface, _ in list(self.ifaces.values()):
                 iface.close()
             self.ifaces.clear()
+            self._reg_version += 1
             self._remote_idx.clear()
             if fd is not None:
                 self.loop.remove(fd)
@@ -283,6 +296,7 @@ class Switch:
             if iface.name == name:
                 iface.close()
                 del self.ifaces[key]
+                self._reg_version += 1
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -299,6 +313,7 @@ class Switch:
                 pass
 
     def _register(self, key, iface: Iface, permanent: bool = False):
+        self._reg_version += 1
         self.ifaces[key] = (iface, float("inf") if permanent else time.monotonic())
         r = getattr(iface, "remote", None)
         if r is not None:
@@ -341,6 +356,7 @@ class Switch:
             if (now - ts) * 1000 > IFACE_TIMEOUT_MS:
                 iface.close()
                 del self.ifaces[key]
+                self._reg_version += 1
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -348,7 +364,7 @@ class Switch:
     def _tap_frame(self, iface: TapIface, ether) -> None:
         self.stack.input_vxlan(Vxlan(iface.local_side_vni, ether), iface)
 
-    RECV_BURST = 512  # datagrams drained per wakeup before batch classify
+    RECV_BURST = 1024  # datagrams drained per wakeup before batch classify
 
     def _on_readable(self, fd: int, ev: int) -> None:
         """Drain a burst, then process it with batched ACL + LPM: the
@@ -378,11 +394,11 @@ class Switch:
                 return None
         return None
 
-    def _resolve_bare(self, pkt: Vxlan, remote: tuple[str, int]):
-        """-> (pkt, iface) with the iface registry resolved/refreshed.
-        A configured remote-switch/ucli link for this addr reuses that
-        iface identity instead of a new bare one (the index keeps
-        configured links in priority — _register)."""
+    def _resolve_remote_key(self, remote: tuple[str, int]):
+        """-> (iface, registry key) for a bare sender addr, registered/
+        refreshed. A configured remote-switch/ucli link for this addr
+        reuses that iface identity instead of a new bare one (the index
+        keeps configured links in priority — _register)."""
         key = self._remote_idx.get(remote)
         ent = self.ifaces.get(key) if key is not None else None
         if ent is None:
@@ -396,11 +412,28 @@ class Switch:
         else:
             known = ent[0]
         self._touch(key)
+        return known, key
+
+    def _resolve_remote(self, remote: tuple[str, int]):
+        return self._resolve_remote_key(remote)[0]
+
+    def _resolve_bare(self, pkt: Vxlan, remote: tuple[str, int]):
+        known = self._resolve_remote(remote)
         if known.local_side_vni:
             pkt = Vxlan(known.local_side_vni, pkt.ether)
         return pkt, known
 
     def _input_batch(self, burst) -> None:
+        pending = None
+        if self.fastpath is not None:
+            # leftovers (control frames, non-bare, v6) run through the
+            # object pipeline FIRST in arrival order, so their table
+            # learns are visible to the vectorized rows flushed after
+            burst, pending = self.fastpath.split(burst)
+            if not burst:
+                if pending is not None:
+                    self.fastpath.flush(pending)
+                return
         bare: list = []    # (Vxlan, remote)
         other: list = []   # (data, remote) — encrypted / non-vxlan
         for data, ip, port in burst:
@@ -420,6 +453,8 @@ class Switch:
             self.stack.input_vxlan_batch(admitted)
         for data, remote in other:
             self._input(data, remote)
+        if pending is not None:
+            self.fastpath.flush(pending)
 
     def _input(self, data: bytes, remote: tuple[str, int]) -> None:
         pkt = self._parse_bare(data)
