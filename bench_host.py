@@ -56,11 +56,15 @@ def start_server():
     return p, port
 
 
-def run_client(port, conns, secs, pipeline):
-    out = subprocess.run(
-        [BIN, "client", "127.0.0.1", str(port), str(conns), str(secs),
-         str(pipeline)],
-        stdout=subprocess.PIPE, text=True, timeout=secs + 30)
+def run_client(port, conns, secs, pipeline, tls_sni=None):
+    if tls_sni is None:
+        cmd = [BIN, "client", "127.0.0.1", str(port), str(conns),
+               str(secs), str(pipeline)]
+    else:
+        cmd = [BIN, "tlsclient", "127.0.0.1", str(port), tls_sni,
+               str(conns), str(secs), str(pipeline)]
+    out = subprocess.run(cmd, stdout=subprocess.PIPE, text=True,
+                         timeout=secs + 60)
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
@@ -144,6 +148,39 @@ def main():
                 r = run_client(lb.bind_port, conns, secs, pipeline)
                 result[key] = r["rps"]
                 result[key.replace("_rps", "_errors")] = r["errors"]
+                flush()
+            finally:
+                lb.stop()
+                lb = None
+
+        # TLS-terminating protocol=tcp: the C-side OpenSSL splice pump
+        # (SSLWrapRingBuffer-at-engine-speed analog). Contract: within
+        # 2x of the plaintext splice rate.
+        from vproxy_tpu.net import vtl as _vtl
+        if _vtl.tls_available():
+            import tempfile
+            d = tempfile.mkdtemp(prefix="hostbench-tls-")
+            cert, keyf = os.path.join(d, "c.crt"), os.path.join(d, "c.key")
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-nodes", "-keyout", keyf, "-out", cert, "-days", "2",
+                 "-subj", "/CN=bench.example.com"],
+                check=True, capture_output=True)
+            from vproxy_tpu.components.certkey import CertKey
+            ck = CertKey("bench", cert, keyf)
+            lb = TcpLB("lb-tls", acceptor, elg, "127.0.0.1", 0, ups,
+                       protocol="tcp", cert_keys=[ck])
+            lb.start()
+            try:
+                run_client(lb.bind_port, min(conns, 4), 1.0, 1,
+                           tls_sni="bench.example.com")
+                r = run_client(lb.bind_port, conns, secs, pipeline,
+                               tls_sni="bench.example.com")
+                result["host_tls_rps"] = r["rps"]
+                result["host_tls_errors"] = r["errors"]
+                if result.get("host_tcp_rps"):
+                    result["host_tls_vs_plain"] = round(
+                        r["rps"] / result["host_tcp_rps"], 3)
                 flush()
             finally:
                 lb.stop()

@@ -189,3 +189,172 @@ def test_tls_command_grammar(stack, certs, tmp_path):
         assert "cert-key ckw" in lb_line
     finally:
         app.close()
+
+
+def test_native_tls_splice_large_bidirectional(stack, certs):
+    """The C-side TLS pump (vtl_tls_pump_new) moves multi-megabyte
+    payloads BOTH directions through ring wraps, and the LB byte
+    counters prove the session rode the native pump (bytes_in counts
+    a2b plaintext only on pump completion)."""
+    import socket as _s
+    import threading
+    import time
+
+    from vproxy_tpu.net import vtl
+    if not vtl.tls_available() or vtl.PROVIDER != "native":
+        pytest.skip("native TLS unavailable")
+    elg = stack["make_elg"](1)
+
+    # echo backend that returns exactly what it receives
+    srv = _s.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    sport = srv.getsockname()[1]
+
+    def serve_one(c):
+        c.settimeout(10)
+        try:
+            while True:
+                d = c.recv(65536)
+                if not d:
+                    break
+                c.sendall(d)
+        except OSError:
+            pass
+        c.close()
+
+    def echo():  # accept loop: health-check probes connect too
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=serve_one, args=(c,),
+                             daemon=True).start()
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    stack["groups"].append(g)
+    g.add("e", "127.0.0.1", sport)
+    wait_healthy(g, 1)
+    u = Upstream("u")
+    u.add(g)
+    ck = CertKey("a", *certs["a"])
+    lb = TcpLB("lb-ntls", elg, elg, "127.0.0.1", 0, u,
+               protocol="tcp", cert_keys=[ck])
+    stack["lbs"].append(lb)
+    lb.start()
+
+    payload = bytes(range(256)) * 4096 * 4  # 4 MiB (many ring wraps)
+    cx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    cx.check_hostname = False
+    cx.verify_mode = ssl.CERT_NONE
+    got = bytearray()
+    with _s.create_connection(("127.0.0.1", lb.bind_port), timeout=10) as raw:
+        with cx.wrap_socket(raw, server_hostname="a.example.com") as c:
+            # single-threaded nonblocking interleave: send and drain
+            # concurrently without the two-threads-on-one-SSLSocket trap
+            c.setblocking(False)
+            view = memoryview(payload)
+            deadline = time.time() + 60
+            while len(got) < len(payload):
+                assert time.time() < deadline, (len(got), len(view))
+                progressed = False
+                if view:
+                    try:
+                        n = c.send(view[:65536])
+                        view = view[n:]
+                        progressed = True
+                    except (ssl.SSLWantWriteError, ssl.SSLWantReadError,
+                            BlockingIOError):
+                        pass
+                try:
+                    d = c.recv(65536)
+                    if d:
+                        got += d
+                        progressed = True
+                except (ssl.SSLWantReadError, ssl.SSLWantWriteError):
+                    pass
+                if not progressed:
+                    time.sleep(0.001)
+    assert bytes(got) == payload
+    srv.close()
+    # pump completion is async; the byte counters land on DONE
+    deadline = time.time() + 5
+    while time.time() < deadline and lb.bytes_in < len(payload):
+        time.sleep(0.05)
+    assert lb.bytes_in >= len(payload)   # plaintext a2b through the pump
+    assert lb.bytes_out >= len(payload)  # plaintext b2a through the pump
+
+
+def test_native_tls_partial_hello_rearm(stack, certs):
+    """A ClientHello delivered in two fragments with a pause: the SNI
+    peek parks read interest between fragments (no level-triggered
+    busy-spin) and completes the handshake when the rest arrives."""
+    import socket as _s
+    import threading
+    import time
+
+    from vproxy_tpu.net import vtl
+    if not vtl.tls_available() or vtl.PROVIDER != "native":
+        pytest.skip("native TLS unavailable")
+    elg = stack["make_elg"](1)
+    srv = IdServer("P")
+    stack["servers"].append(srv)
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    stack["groups"].append(g)
+    g.add("p", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    u = Upstream("u")
+    u.add(g)
+    lb = TcpLB("lb-part", elg, elg, "127.0.0.1", 0, u,
+               protocol="tcp", cert_keys=[CertKey("a", *certs["a"])])
+    stack["lbs"].append(lb)
+    lb.start()
+
+    # build a real ClientHello by handshaking against a throwaway
+    # in-memory server? simpler: capture the bytes a python client
+    # would send by sniffing through a plain socket pair is overkill —
+    # drive the split through a socket proxy thread instead.
+    up = _s.socket()
+    up.bind(("127.0.0.1", 0))
+    up.listen(1)
+    pport = up.getsockname()[1]
+
+    def splitter():
+        c, _ = up.accept()
+        c.settimeout(10)
+        out = _s.create_connection(("127.0.0.1", lb.bind_port), timeout=10)
+        first = c.recv(65536)  # the client's full ClientHello
+        out.sendall(first[:20])          # fragment 1: record prefix only
+        time.sleep(0.3)                  # parked window
+        out.sendall(first[20:])          # rest of the hello
+        # then relay transparently both ways
+        c.setblocking(False)
+        out.setblocking(False)
+        end = time.time() + 10
+        while time.time() < end:
+            moved = False
+            for a, b in ((c, out), (out, c)):
+                try:
+                    d = a.recv(65536)
+                    if d:
+                        b.sendall(d)
+                        moved = True
+                except (BlockingIOError, _s.error):
+                    pass
+            if not moved:
+                time.sleep(0.01)
+
+    threading.Thread(target=splitter, daemon=True).start()
+
+    cx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    cx.check_hostname = False
+    cx.verify_mode = ssl.CERT_NONE
+    with _s.create_connection(("127.0.0.1", pport), timeout=10) as raw:
+        with cx.wrap_socket(raw, server_hostname="a.example.com") as c:
+            c.settimeout(10)
+            c.sendall(b"frag")
+            assert c.recv(10).startswith(b"P")

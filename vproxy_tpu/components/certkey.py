@@ -43,7 +43,41 @@ class CertKey:
         self.cert_path = cert_path
         self.key_path = key_path
         self.dns_names = [n.lower() for n in _cert_dns_names(cert_path)]
+        import threading
+        self._native = None  # lazy native SSL_CTX handle (int) or False
+        self._native_lock = threading.Lock()
         self.make_ctx()  # validate cert/key pair up front
+
+    def native_ctx(self):
+        """Native OpenSSL SSL_CTX handle for the C-side TLS splice pump
+        (net/vtl.py tls_ctx_new), or None when native TLS is
+        unavailable. Lazy and cached for the CertKey's lifetime —
+        in-flight SSL sessions refcount the ctx, so the handle staying
+        alive with the resource is the simple safe ownership."""
+        with self._native_lock:
+            if self._native is None:
+                from ..net import vtl
+                try:
+                    if vtl.tls_available():
+                        self._native = vtl.tls_ctx_new(self.cert_path,
+                                                       self.key_path)
+                    else:
+                        self._native = False
+                except OSError:
+                    self._native = False
+            return self._native or None
+
+    def close_native(self) -> None:
+        """Release the native SSL_CTX (cert-key removal / rotation).
+        In-flight TLS sessions hold their own refs (OpenSSL refcounts
+        the ctx via SSL_new), so freeing here never kills live splices;
+        new handshakes on this CertKey become impossible — which is the
+        point of removing it."""
+        with self._native_lock:
+            h, self._native = self._native, False
+        if h:
+            from ..net import vtl
+            vtl.tls_ctx_free(h)
 
     def make_ctx(self) -> ssl.SSLContext:
         """Fresh server context; each holder (LB) builds its own so ALPN
@@ -79,6 +113,14 @@ class CertKeyHolder:
                 ctx.set_alpn_protocols(alpn)
         self.front_context = self._ctxs[0]
         install_sni_chooser(self.front_context, self.choose)
+
+    def choose_cert_key(self, sni: Optional[str]) -> "CertKey":
+        """The CertKey serving `sni` (exact -> wildcard -> default)."""
+        if sni:
+            for ck in self.cert_keys:
+                if ck.matches(sni):
+                    return ck
+        return self.cert_keys[0]
 
     def choose(self, sni: Optional[str]) -> Optional[ssl.SSLContext]:
         if not sni:

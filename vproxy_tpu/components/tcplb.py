@@ -189,10 +189,20 @@ class TcpLB:
                 pass  # L7Engine closes cfd on its failure paths
 
     def _serve_tls(self, loop, cfd: int, ip: str, port: int) -> None:
-        """TLS termination: decrypted bytes run through the L7 engine (the
-        native splice pump cannot cross python-resident TLS state). For
-        protocol=tcp the SNI becomes the classify hint
-        (SSLUnwrapRingBuffer.java:174-186 -> SSLContextHolder.choose)."""
+        """TLS termination. protocol=tcp on the native provider takes
+        the C-side path: MSG_PEEK the ClientHello for SNI (cert choice +
+        classify hint), then hand the untouched socket to the OpenSSL
+        splice pump — handshake and record layer run in C, TLS bytes
+        never enter Python (the reference's engine-speed SSL rings,
+        SSLWrapRingBuffer.java:23/SSLUnwrapRingBuffer.java:28). L7
+        protocols (and the pure-python provider, or mirror taps wanting
+        plaintext) keep the MemoryBIO path through the L7 engine."""
+        import os as _os
+        if (self.protocol == "tcp" and vtl.PROVIDER == "native"
+                and _os.environ.get("VPROXY_TPU_NATIVE_TLS", "1") != "0"
+                and vtl.tls_available() and not self._mirror_wants_tls()):
+            self._serve_tls_native(loop, cfd, ip, port)
+            return
         from ..net.tls import TlsSocket
         from ..processors.base import TcpRelaySession
         from ..rules.ir import Hint
@@ -211,6 +221,166 @@ class TcpLB:
             name = "http1" if self.protocol == "http-splice" else self.protocol
             factory = processors.get(name)
         L7Engine(self, loop, cfd, ip, port, factory, front=tls)
+
+    def _mirror_wants_tls(self) -> bool:
+        """Plaintext mirror taps need the python TLS path (the native
+        pump's plaintext never surfaces to the mirror)."""
+        from ..utils.mirror import Mirror
+        m = Mirror.get()
+        return m.hot and m.wants("tls")
+
+    def _serve_tls_native(self, loop, cfd: int, ip: str, port: int) -> None:
+        """Peek the ClientHello (bytes stay queued), choose the cert and
+        classify by SNI, connect the backend, then run the C-side
+        TLS-terminating splice pump on the untouched client socket."""
+        from ..net.sniff import MAX_HELLO, parse_client_hello_sni
+        from ..rules.ir import Hint
+        lb = self
+        deadline = [loop.delay(self.timeout_ms, lambda: self._peek_abort(
+            loop, cfd))]
+
+        def on_ev(fd: int, ev: int) -> None:
+            if ev & vtl.EV_ERROR:
+                self._peek_abort(loop, cfd, deadline)
+                return
+            try:
+                data = vtl.recv_peek(cfd, MAX_HELLO)
+            except OSError:
+                self._peek_abort(loop, cfd, deadline)
+                return
+            if data is None:
+                return  # spurious wakeup
+            if not data:
+                self._peek_abort(loop, cfd, deadline)  # EOF before hello
+                return
+            sni, complete = parse_client_hello_sni(data)
+            if not complete:
+                # MSG_PEEK leaves the fd readable: a level-triggered
+                # re-arm here would busy-spin until the hello completes.
+                # Park interest and re-check shortly (deadline still
+                # bounds the total wait).
+                try:
+                    loop.modify(cfd, 0)
+
+                    def rearm() -> None:
+                        if deadline[0] is None:  # aborted meanwhile
+                            return
+                        try:
+                            if loop.registered(cfd):
+                                loop.modify(cfd, vtl.EV_READ)
+                        except Exception:
+                            pass
+                    loop.delay(20, rearm)
+                except Exception:
+                    self._peek_abort(loop, cfd, deadline)
+                return  # wait for more ClientHello bytes
+            if deadline[0] is not None:
+                deadline[0].cancel()
+                deadline[0] = None
+            loop.remove(cfd)
+            ck = self.holder.choose_cert_key(sni)
+            ctx = ck.native_ctx()
+            if ctx is None:
+                # libssl vanished / cert unreadable: python TLS fallback
+                self._serve_tls_python_fallback(loop, cfd, ip, port)
+                return
+            hint = Hint.of_host(sni) if sni else None
+
+            def on_back(back) -> None:
+                if back is None:
+                    vtl.close(cfd)
+                    return
+                self._splice_tls(loop, cfd, back, ctx,
+                                 front=f"{ip}:{port}")
+
+            lb.backend.next_async(parse_ip(ip), hint, on_back, loop=loop)
+
+        try:
+            loop.add(cfd, vtl.EV_READ, on_ev)
+        except OSError:
+            if deadline[0] is not None:  # the timer must not fire on a
+                deadline[0].cancel()     # closed (reusable) fd number
+                deadline[0] = None
+            vtl.close(cfd)
+
+    def _peek_abort(self, loop, cfd: int, deadline=None) -> None:
+        if deadline and deadline[0] is not None:
+            deadline[0].cancel()
+            deadline[0] = None
+        try:
+            if loop.registered(cfd):
+                loop.remove(cfd)
+        except Exception:
+            pass
+        vtl.close(cfd)
+
+    def _serve_tls_python_fallback(self, loop, cfd: int, ip: str,
+                                   port: int) -> None:
+        from ..net.tls import TlsSocket
+        from ..processors.base import TcpRelaySession
+        from ..rules.ir import Hint
+        try:
+            conn = Connection(loop, cfd, (ip, port))
+        except OSError:
+            vtl.close(cfd)
+            return
+        tls = TlsSocket(conn, self.holder.front_context)
+
+        def factory(eng, addr):
+            return TcpRelaySession(
+                eng, addr,
+                hint_fn=lambda: Hint.of_host(tls.sni) if tls.sni else None)
+
+        L7Engine(self, loop, cfd, ip, port, factory, front=tls)
+
+    def _splice_tls(self, loop, front_fd: int, target: Connector,
+                    ctx: int, front: str = "?") -> None:
+        """Like _splice, but the handover runs the TLS-terminating pump
+        (client side TLS in C, backend plaintext)."""
+        lb = self
+        svr = target.svr
+        svr.conn_count += 1
+        self.active_sessions += 1
+        try:
+            back = Connection.connect(loop, target.ip, target.port)
+        except OSError:
+            svr.conn_count -= 1
+            self.active_sessions -= 1
+            vtl.close(front_fd)
+            return
+
+        class Back(Handler):
+            def on_connected(self, conn: Connection) -> None:
+                conn.pause_reading()
+                self._handover(conn)
+
+            def _handover(self, conn: Connection) -> None:
+                if conn.detached or conn.closed:
+                    return
+                bfd = conn.detach()
+                vtl.set_nodelay(front_fd)
+                vtl.set_nodelay(bfd)
+                pid = loop.pump_tls(front_fd, bfd, ctx, lb.in_buffer_size,
+                                    self._done)
+                self._pid = pid
+                lb._watch_pump(loop, pid,
+                               f"tls {front} -> {target.ip}:{target.port}")
+
+            def _done(self, a2b: int, b2a: int, err: int) -> None:
+                lb._unwatch_pump(loop, getattr(self, "_pid", None))
+                lb.bytes_in += a2b
+                lb.bytes_out += b2a
+                svr.bytes_in += a2b
+                svr.bytes_out += b2a
+                svr.conn_count -= 1
+                lb.active_sessions -= 1
+
+            def on_closed(self, conn: Connection, err: int) -> None:
+                svr.conn_count -= 1
+                lb.active_sessions -= 1
+                vtl.close(front_fd)
+
+        back.set_handler(Back())
 
     # ------------------------------------------------------ idle timeout
 

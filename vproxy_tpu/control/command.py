@@ -505,6 +505,7 @@ def _h_ck(app: Application, c: Command):
         if users and c.action == "remove":
             raise CmdError(f"cert-key {c.alias} is in use by {users}")
         del app.cert_keys[c.alias]
+        ck.close_native()  # release the native SSL_CTX (live refs stay)
         return "OK"
     raise CmdError(f"unsupported action {c.action} for cert-key")
 

@@ -265,14 +265,194 @@ static int run_client(const char *ip, int port, int nconn, double secs,
     return 0;
 }
 
+// ----------------------------------------------------------- tls client
+//
+// TLS load mode for the TLS-terminating TcpLB bench: OpenSSL resolved
+// with dlopen (no dev headers in this image; the ABI is stable), client
+// handshakes run BEFORE the timed window, then the same pipelined
+// request loop rides SSL_read/SSL_write nonblocking.
+
+#include <dlfcn.h>
+
+typedef struct ssl_ctx_st SSL_CTX_;
+typedef struct ssl_st SSL_;
+static struct {
+    const void *(*TLS_client_method)(void);
+    SSL_CTX_ *(*SSL_CTX_new)(const void *);
+    long (*SSL_CTX_ctrl)(SSL_CTX_ *, int, long, void *);
+    SSL_ *(*SSL_new)(SSL_CTX_ *);
+    int (*SSL_set_fd)(SSL_ *, int);
+    int (*SSL_connect)(SSL_ *);
+    int (*SSL_read)(SSL_ *, void *, int);
+    int (*SSL_write)(SSL_ *, const void *, int);
+    int (*SSL_get_error)(const SSL_ *, int);
+    long (*SSL_ctrl)(SSL_ *, int, long, void *);
+} T;
+
+static int tls_load() {
+    void *h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return -1;
+    dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+#define S(n)                                   \
+    *(void **)(&T.n) = dlsym(h, #n);           \
+    if (!T.n) return -1;
+    S(TLS_client_method) S(SSL_CTX_new) S(SSL_CTX_ctrl) S(SSL_new)
+    S(SSL_set_fd) S(SSL_connect) S(SSL_read) S(SSL_write) S(SSL_get_error)
+    S(SSL_ctrl)
+#undef S
+    return 0;
+}
+
+static SSL_ *tlss[MAXFD];
+
+static int run_tls_client(const char *ip, int port, const char *sni,
+                          int nconn, double secs, int pipeline) {
+    signal(SIGPIPE, SIG_IGN);
+    if (tls_load() != 0) {
+        fprintf(stderr, "libssl unavailable\n");
+        return 3;
+    }
+    SSL_CTX_ *ctx = T.SSL_CTX_new(T.TLS_client_method());
+    T.SSL_CTX_ctrl(ctx, 33 /*SSL_CTRL_MODE*/, 1L | 2L /*partial+moving*/,
+                   nullptr);
+    int ep = epoll_create1(0);
+    long long done = 0, errors = 0;
+    int one = 1;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, ip, &sa.sin_addr);
+
+    for (int i = 0; i < nconn; i++) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        if (fd < 0 || fd >= MAXFD) {
+            if (fd >= 0) close(fd);
+            errors++;
+            continue;
+        }
+        if (connect(fd, (sockaddr *)&sa, sizeof(sa)) != 0) {
+            close(fd);
+            errors++;
+            continue;
+        }
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        SSL_ *ssl = T.SSL_new(ctx);
+        T.SSL_set_fd(ssl, fd);
+        // SSL_set_tlsext_host_name = SSL_ctrl(ssl, 55, 0, name)
+        T.SSL_ctrl(ssl, 55, 0, (void *)sni);
+        if (T.SSL_connect(ssl) != 1) {  // blocking handshake (pre-window)
+            close(fd);
+            errors++;
+            continue;
+        }
+        set_nonblock(fd);
+        tlss[fd] = ssl;
+        conns[fd] = Conn{};
+        epoll_event ce{};
+        ce.events = EPOLLIN;
+        ce.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ce);
+        Conn &c = conns[fd];
+        for (int p = 0; p < pipeline; p++) {
+            c.out.append(REQ, REQ_LEN);
+            c.inflight++;
+        }
+    }
+    // helper: flush c.out through SSL_write; -1 fatal, 0 would-block-write
+    auto tls_flush = [&](int fd, Conn &c) -> int {
+        while (!c.out.empty()) {
+            int w = T.SSL_write(tlss[fd], c.out.data(), (int)c.out.size());
+            if (w > 0) {
+                c.out.erase(0, (size_t)w);
+            } else {
+                int e = T.SSL_get_error(tlss[fd], w);
+                if (e == 3) return 0;   // WANT_WRITE
+                if (e == 2) return 1;   // WANT_READ: retry on next read ev
+                return -1;
+            }
+        }
+        return 1;
+    };
+    for (int fd = 0; fd < MAXFD; fd++)
+        if (tlss[fd]) {
+            int r = tls_flush(fd, conns[fd]);
+            if (r < 0) { drop(ep, fd); tlss[fd] = nullptr; errors++; }
+            else if (r == 0) {
+                epoll_event ce{};
+                ce.events = EPOLLIN | EPOLLOUT;
+                ce.data.fd = fd;
+                epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ce);
+            }
+        }
+
+    char buf[65536];
+    epoll_event evs[256];
+    double t0 = now_s(), tend = t0 + secs;
+    while (now_s() < tend) {
+        int n = epoll_wait(ep, evs, 256, 100);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            Conn &c = conns[fd];
+            bool dead = false;
+            for (;;) {
+                int r = T.SSL_read(tlss[fd], buf, sizeof(buf));
+                if (r > 0) {
+                    c.rxbytes += (size_t)r;
+                    continue;
+                }
+                int e = T.SSL_get_error(tlss[fd], r);
+                if (e == 2 || e == 3) break;  // drained
+                dead = true;
+                break;
+            }
+            if (dead) {
+                drop(ep, fd);
+                tlss[fd] = nullptr;
+                errors++;
+                continue;
+            }
+            while (c.rxbytes >= RESP_LEN && c.inflight > 0) {
+                c.rxbytes -= RESP_LEN;
+                c.inflight--;
+                done++;
+                c.out.append(REQ, REQ_LEN);
+                c.inflight++;
+            }
+            int fr = tls_flush(fd, c);
+            if (fr < 0) {
+                drop(ep, fd);
+                tlss[fd] = nullptr;
+                errors++;
+            } else {
+                epoll_event ce{};
+                ce.events = fr == 0 ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+                ce.data.fd = fd;
+                epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ce);
+            }
+        }
+    }
+    double el = now_s() - t0;
+    printf("{\"reqs\": %lld, \"secs\": %.3f, \"rps\": %.1f, "
+           "\"errors\": %lld, \"conns\": %d, \"pipeline\": %d}\n",
+           done, el, done / el, errors, nconn, pipeline);
+    fflush(stdout);
+    return 0;
+}
+
 int main(int argc, char **argv) {
     if (argc >= 3 && strcmp(argv[1], "server") == 0)
         return run_server(atoi(argv[2]));
     if (argc >= 7 && strcmp(argv[1], "client") == 0)
         return run_client(argv[2], atoi(argv[3]), atoi(argv[4]),
                           atof(argv[5]), atoi(argv[6]));
+    if (argc >= 8 && strcmp(argv[1], "tlsclient") == 0)
+        return run_tls_client(argv[2], atoi(argv[3]), argv[4],
+                              atoi(argv[5]), atof(argv[6]), atoi(argv[7]));
     fprintf(stderr,
             "usage: hostbench server <port>\n"
-            "       hostbench client <ip> <port> <conns> <secs> <pipeline>\n");
+            "       hostbench client <ip> <port> <conns> <secs> <pipeline>\n"
+            "       hostbench tlsclient <ip> <port> <sni> <conns> <secs> "
+            "<pipeline>\n");
     return 2;
 }

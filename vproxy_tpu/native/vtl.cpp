@@ -11,11 +11,13 @@
 // C ABI only (ctypes-friendly). Level-triggered epoll with explicit
 // interest management.
 #include <arpa/inet.h>
+#include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <stdint.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
@@ -286,6 +288,17 @@ struct Pump {
   bool dead = false;
   int err = 0;
   uint64_t bytes_a2b = 0, bytes_b2a = 0;
+  // TLS-terminating pumps (vtl_tls_pump_new): side A is a TLS client
+  // (this process is the server), side B plaintext; ssl owns the
+  // record layer over fd_a via SSL_set_fd.
+  void* ssl = nullptr;
+  bool handshaking = false;
+  // A-side SSL demands, split by direction: SSL_read's WANT_READ is the
+  // NORMAL idle state (no complete record) and must not stall B->A;
+  // only SSL_write's wants gate the write flush.
+  bool rd_want_write = false;               // SSL_read needs fd writable
+  bool wr_want_read = false, wr_want_write = false;  // SSL_write stalled
+  bool hs_want_write = false;
   Pump(uint64_t i, int a, int b, size_t cap)
       : id(i), fd_a(a), fd_b(b), a2b(cap), b2a(cap) {}
 };
@@ -374,6 +387,117 @@ int vtl_del(void* lp, int fd) {
   return 0;
 }
 
+// ---------------------------------------------------------------- openssl
+//
+// The image ships libssl.so.3 but no development headers, so the needed
+// OpenSSL 3 ABI (stable) is declared here and resolved with dlopen at
+// vtl_tls_init() time. TLS stays strictly optional: without the library
+// every vtl_tls_* call reports -ENOSYS and the plain pump is unaffected.
+
+typedef struct ssl_ctx_st SSL_CTX_;
+typedef struct ssl_st SSL_;
+
+#define SSL_FILETYPE_PEM_ 1
+#define SSL_CTRL_MODE_ 33
+#define SSL_MODE_ENABLE_PARTIAL_WRITE_ 1L
+#define SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER_ 2L
+#define SSL_ERROR_WANT_READ_ 2
+#define SSL_ERROR_WANT_WRITE_ 3
+#define SSL_ERROR_SYSCALL_ 5
+#define SSL_ERROR_ZERO_RETURN_ 6
+
+static struct {
+  bool ready = false;
+  const void* (*TLS_server_method)(void);
+  SSL_CTX_* (*SSL_CTX_new)(const void*);
+  void (*SSL_CTX_free)(SSL_CTX_*);
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX_*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX_*, const char*, int);
+  int (*SSL_CTX_check_private_key)(const SSL_CTX_*);
+  long (*SSL_CTX_ctrl)(SSL_CTX_*, int, long, void*);
+  SSL_* (*SSL_new)(SSL_CTX_*);
+  void (*SSL_free)(SSL_*);
+  int (*SSL_set_fd)(SSL_*, int);
+  void (*SSL_set_accept_state)(SSL_*);
+  int (*SSL_do_handshake)(SSL_*);
+  int (*SSL_read)(SSL_*, void*, int);
+  int (*SSL_write)(SSL_*, const void*, int);
+  int (*SSL_get_error)(const SSL_*, int);
+  int (*SSL_shutdown)(SSL_*);
+  void (*ERR_clear_error)(void);
+} TLSA;
+
+int vtl_tls_init(void) {
+  if (TLSA.ready) return 0;
+  void* h = dlopen("libssl.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) h = dlopen("libssl.so", RTLD_NOW | RTLD_GLOBAL);
+  if (!h) return -ENOSYS;
+  void* hc = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+  if (!hc) hc = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+#define VTL_SYM(lib, name)                                        \
+  *(void**)(&TLSA.name) = dlsym(lib, #name);                      \
+  if (!TLSA.name) return -ENOSYS;
+  VTL_SYM(h, TLS_server_method)
+  VTL_SYM(h, SSL_CTX_new)
+  VTL_SYM(h, SSL_CTX_free)
+  VTL_SYM(h, SSL_CTX_use_certificate_chain_file)
+  VTL_SYM(h, SSL_CTX_use_PrivateKey_file)
+  VTL_SYM(h, SSL_CTX_check_private_key)
+  VTL_SYM(h, SSL_CTX_ctrl)
+  VTL_SYM(h, SSL_new)
+  VTL_SYM(h, SSL_free)
+  VTL_SYM(h, SSL_set_fd)
+  VTL_SYM(h, SSL_set_accept_state)
+  VTL_SYM(h, SSL_do_handshake)
+  VTL_SYM(h, SSL_read)
+  VTL_SYM(h, SSL_write)
+  VTL_SYM(h, SSL_get_error)
+  VTL_SYM(h, SSL_shutdown)
+  if (hc) {
+    *(void**)(&TLSA.ERR_clear_error) = dlsym(hc, "ERR_clear_error");
+  }
+  if (!TLSA.ERR_clear_error)
+    *(void**)(&TLSA.ERR_clear_error) = dlsym(h, "ERR_clear_error");
+  if (!TLSA.ERR_clear_error) return -ENOSYS;
+#undef VTL_SYM
+  TLSA.ready = true;
+  return 0;
+}
+
+// -> SSL_CTX handle (as int64) or -errno. One ctx per cert-key; SSL
+// objects refcount it, so freeing the ctx after a holder swap is safe
+// while sessions created from it live on.
+long long vtl_tls_ctx_new(const char* cert_path, const char* key_path) {
+  if (!TLSA.ready && vtl_tls_init() < 0) return -ENOSYS;
+  SSL_CTX_* ctx = TLSA.SSL_CTX_new(TLSA.TLS_server_method());
+  if (!ctx) return -ENOMEM;
+  if (TLSA.SSL_CTX_use_certificate_chain_file(ctx, cert_path) != 1 ||
+      TLSA.SSL_CTX_use_PrivateKey_file(ctx, key_path, SSL_FILETYPE_PEM_) != 1 ||
+      TLSA.SSL_CTX_check_private_key(ctx) != 1) {
+    TLSA.SSL_CTX_free(ctx);
+    return -EINVAL;
+  }
+  // SSL_write retries may pass a different (advanced) pointer after a
+  // short write — both modes are required for ring-buffer flushing
+  TLSA.SSL_CTX_ctrl(ctx, SSL_CTRL_MODE_,
+                    SSL_MODE_ENABLE_PARTIAL_WRITE_ |
+                        SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER_,
+                    nullptr);
+  return (long long)(intptr_t)ctx;
+}
+
+int vtl_tls_ctx_free(long long ctx) {
+  if (!TLSA.ready || !ctx) return -EINVAL;
+  TLSA.SSL_CTX_free((SSL_CTX_*)(intptr_t)ctx);
+  return 0;
+}
+
+// MSG_PEEK (the SNI sniffer reads the ClientHello without consuming it)
+int vtl_recv_peek(int fd, void* buf, int len) {
+  ssize_t n = recv(fd, buf, (size_t)len, MSG_PEEK);
+  return n < 0 ? -errno : (int)n;
+}
+
 // ------------------------------------------------------------ pump engine
 
 static void pump_update_interest(Loop* l, Pump* p);
@@ -382,6 +506,10 @@ static void pump_kill(Loop* l, Pump* p, int err) {
   if (p->dead) return;
   p->dead = true;
   p->err = err;
+  if (p->ssl) {
+    TLSA.SSL_free((SSL_*)p->ssl);  // does not close fd_a (SSL_set_fd)
+    p->ssl = nullptr;
+  }
   for (int fd : {p->fd_a, p->fd_b}) {
     auto it = l->handlers.find(fd);
     if (it != l->handlers.end()) {
@@ -451,8 +579,182 @@ static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
   return true;
 }
 
+// ---- TLS-terminating pump: A = TLS client side (SSL owns the record
+// layer over fd_a), B = plaintext backend. The same ring discipline as
+// pump_flow, with SSL_read/SSL_write in place of read/write on A and
+// WANT_READ/WANT_WRITE driving A's epoll interest (renegotiations and
+// mid-write stalls included).
+
+static void tls_update_interest(Loop* l, Pump* p);
+
+// classify an SSL_* return: 0 = want/eof handled (flags set), -1 = killed
+static int tls_err(Loop* l, Pump* p, int r, bool* eof_out,
+                   bool* want_read, bool* want_write) {
+  int e = TLSA.SSL_get_error((SSL_*)p->ssl, r);
+  if (e == SSL_ERROR_WANT_READ_) {
+    if (want_read) *want_read = true;
+    return 0;
+  }
+  if (e == SSL_ERROR_WANT_WRITE_) {
+    if (want_write) *want_write = true;
+    return 0;
+  }
+  if (e == SSL_ERROR_ZERO_RETURN_ && eof_out) { *eof_out = true; return 0; }
+  if (e == SSL_ERROR_SYSCALL_ && eof_out && (errno == 0 || r == 0)) {
+    *eof_out = true;  // peer dropped without close_notify
+    return 0;
+  }
+  pump_kill(l, p, e == SSL_ERROR_SYSCALL_ && errno ? errno : EPROTO);
+  return -1;
+}
+
+static void tls_pump_run(Loop* l, Pump* p) {
+  if (p->dead) return;
+  p->rd_want_write = p->wr_want_read = p->wr_want_write = false;
+  p->hs_want_write = false;
+  TLSA.ERR_clear_error();
+  SSL_* ssl = (SSL_*)p->ssl;
+  if (p->handshaking) {
+    int r = TLSA.SSL_do_handshake(ssl);
+    if (r == 1) {
+      p->handshaking = false;
+    } else {
+      bool dummy = false;
+      if (tls_err(l, p, r, nullptr, &dummy, &p->hs_want_write) < 0) return;
+      tls_update_interest(l, p);
+      return;
+    }
+  }
+  // flush decrypted a2b -> B
+  Ring& ab = p->a2b;
+  while (!ab.empty()) {
+    size_t chunk = std::min(ab.size, ab.cap() - ab.head);
+    ssize_t n = write(p->fd_b, ab.buf.data() + ab.head, chunk);
+    if (n > 0) {
+      ab.head = (ab.head + (size_t)n) % ab.cap();
+      ab.size -= (size_t)n;
+      p->bytes_a2b += (uint64_t)n;
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      break;
+    } else {
+      pump_kill(l, p, errno ? errno : EPIPE);
+      return;
+    }
+  }
+  // SSL_read A -> a2b (with plaintext write-through to B)
+  while (!p->a_eof && !ab.full()) {
+    size_t tail = (ab.head + ab.size) % ab.cap();
+    size_t chunk = std::min(ab.free_(), ab.cap() - tail);
+    int n = TLSA.SSL_read(ssl, ab.buf.data() + tail, (int)chunk);
+    if (n > 0) {
+      ab.size += (size_t)n;
+      while (!ab.empty()) {
+        size_t c2 = std::min(ab.size, ab.cap() - ab.head);
+        ssize_t w = write(p->fd_b, ab.buf.data() + ab.head, c2);
+        if (w > 0) {
+          ab.head = (ab.head + (size_t)w) % ab.cap();
+          ab.size -= (size_t)w;
+          p->bytes_a2b += (uint64_t)w;
+        } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          pump_kill(l, p, errno ? errno : EPIPE);
+          return;
+        }
+      }
+    } else {
+      bool dummy = false;
+      if (tls_err(l, p, n, &p->a_eof, &dummy, &p->rd_want_write) < 0)
+        return;
+      break;  // WANT_READ here is the normal no-more-records state
+    }
+  }
+  if (p->a_eof && ab.empty() && !p->b_wr_shut) {
+    shutdown(p->fd_b, SHUT_WR);
+    p->b_wr_shut = true;
+  }
+  // flush b2a -> SSL_write A
+  Ring& ba = p->b2a;
+  while (!ba.empty() && !p->wr_want_read && !p->wr_want_write) {
+    size_t chunk = std::min(ba.size, ba.cap() - ba.head);
+    int n = TLSA.SSL_write(ssl, ba.buf.data() + ba.head, (int)chunk);
+    if (n > 0) {
+      ba.head = (ba.head + (size_t)n) % ba.cap();
+      ba.size -= (size_t)n;
+      p->bytes_b2a += (uint64_t)n;
+    } else {
+      if (tls_err(l, p, n, nullptr, &p->wr_want_read,
+                  &p->wr_want_write) < 0)
+        return;
+      break;
+    }
+  }
+  // read B -> b2a (with SSL_write-through); the ring gives backpressure
+  while (!p->b_eof && !ba.full()) {
+    size_t tail = (ba.head + ba.size) % ba.cap();
+    size_t chunk = std::min(ba.free_(), ba.cap() - tail);
+    ssize_t n = read(p->fd_b, ba.buf.data() + tail, chunk);
+    if (n > 0) {
+      ba.size += (size_t)n;
+      while (!ba.empty() && !p->wr_want_read && !p->wr_want_write) {
+        size_t c2 = std::min(ba.size, ba.cap() - ba.head);
+        int w = TLSA.SSL_write(ssl, ba.buf.data() + ba.head, (int)c2);
+        if (w > 0) {
+          ba.head = (ba.head + (size_t)w) % ba.cap();
+          ba.size -= (size_t)w;
+          p->bytes_b2a += (uint64_t)w;
+        } else {
+          if (tls_err(l, p, w, nullptr, &p->wr_want_read,
+                      &p->wr_want_write) < 0)
+            return;
+          break;
+        }
+      }
+    } else if (n == 0) {
+      p->b_eof = true;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else {
+      pump_kill(l, p, errno);
+      return;
+    }
+  }
+  if (p->b_eof && ba.empty() && !p->a_wr_shut) {
+    TLSA.SSL_shutdown(ssl);  // close_notify (best effort, nonblocking)
+    shutdown(p->fd_a, SHUT_WR);
+    p->a_wr_shut = true;
+  }
+  if (p->a_eof && p->b_eof && ab.empty() && ba.empty()) {
+    pump_kill(l, p, 0);
+    return;
+  }
+  tls_update_interest(l, p);
+}
+
+static void tls_update_interest(Loop* l, Pump* p) {
+  auto ha = l->handlers.find(p->fd_a);
+  auto hb = l->handlers.find(p->fd_b);
+  if (ha == l->handlers.end() || hb == l->handlers.end()) return;
+  uint32_t ia = 0, ib = 0;
+  if (p->handshaking) {
+    ia = p->hs_want_write ? VTL_EV_WRITE : VTL_EV_READ;
+  } else {
+    if (p->wr_want_read || (!p->a_eof && !p->a2b.full()))
+      ia |= VTL_EV_READ;
+    if (p->rd_want_write || p->wr_want_write) ia |= VTL_EV_WRITE;
+    if (!p->b_eof && !p->b2a.full()) ib |= VTL_EV_READ;
+    if (!p->a2b.empty()) ib |= VTL_EV_WRITE;
+  }
+  if (ha->second->interest != ia) ep_set(l, ha->second, ia);
+  if (hb->second->interest != ib) ep_set(l, hb->second, ib);
+}
+
 static void pump_run(Loop* l, Pump* p) {
   if (p->dead) return;
+  if (p->ssl) {
+    tls_pump_run(l, p);
+    return;
+  }
   if (!pump_flow(l, p, p->fd_a, p->fd_b, p->a2b, p->a_eof, p->b_wr_shut,
                  p->bytes_a2b))
     return;
@@ -494,6 +796,39 @@ uint64_t vtl_pump_new(void* lp, int fd_a, int fd_b, int bufsize) {
   ep_set(l, ha, VTL_EV_READ);
   ep_set(l, hb, VTL_EV_READ);
   pump_run(l, p);  // kick: there may be buffered bytes ready to read
+  return id;
+}
+
+// TLS-terminating pump: fd_tls speaks TLS (server role, handshake
+// included — the ClientHello is still queued in the socket thanks to
+// the MSG_PEEK sniffer), fd_plain is the backend. Same id space /
+// stat / close / free / DONE notification as the plain pump.
+uint64_t vtl_tls_pump_new(void* lp, int fd_tls, int fd_plain, int bufsize,
+                          long long ctx) {
+  if (!TLSA.ready || !ctx) return 0;
+  Loop* l = (Loop*)lp;
+  if (l->handlers.count(fd_tls) || l->handlers.count(fd_plain)) return 0;
+  SSL_* ssl = TLSA.SSL_new((SSL_CTX_*)(intptr_t)ctx);
+  if (!ssl) return 0;
+  if (TLSA.SSL_set_fd(ssl, fd_tls) != 1) {
+    TLSA.SSL_free(ssl);
+    return 0;
+  }
+  TLSA.SSL_set_accept_state(ssl);
+  uint64_t id = l->next_pump_id++;
+  Pump* p = new Pump(id, fd_tls, fd_plain, (size_t)bufsize);
+  p->ssl = ssl;
+  p->handshaking = true;
+  Handler* ha = new Handler{Handler::PUMP_A, id, p, fd_tls, (uint32_t)-1};
+  Handler* hb = new Handler{Handler::PUMP_B, id, p, fd_plain, (uint32_t)-1};
+  l->handlers[fd_tls] = ha;
+  l->handlers[fd_plain] = hb;
+  l->valid.insert(ha);
+  l->valid.insert(hb);
+  l->pumps[id] = p;
+  ep_set(l, ha, VTL_EV_READ);
+  ep_set(l, hb, VTL_EV_READ);
+  pump_run(l, p);  // the peeked ClientHello is already readable
   return id;
 }
 
@@ -597,6 +932,7 @@ void vtl_free(void* lp) {
   for (Handler* g : l->garbage) delete g;
   for (auto& kv : l->pumps) {
     if (!kv.second->dead) {  // live spliced sessions: close both fds
+      if (kv.second->ssl) TLSA.SSL_free((SSL_*)kv.second->ssl);
       close(kv.second->fd_a);
       close(kv.second->fd_b);
     }

@@ -157,6 +157,22 @@ class SelectorEventLoop:
         self._pump_cbs[pid] = on_done
         return pid
 
+    def pump_tls(self, fd_tls: int, fd_plain: int, ctx: int,
+                 bufsize: int = 65536,
+                 on_done: Optional[Callable[[int, int, int], None]] = None
+                 ) -> int:
+        """TLS-terminating splice: fd_tls speaks TLS (server role, C-side
+        handshake + record layer), fd_plain is plaintext. Same ownership
+        and DONE contract as pump()."""
+        if not self._alive():
+            raise OSError("event loop is closed")
+        pid = vtl.LIB.vtl_tls_pump_new(self._lp, fd_tls, fd_plain, bufsize,
+                                       ctx)
+        if pid == 0:
+            raise OSError("tls pump: fds busy or tls unavailable")
+        self._pump_cbs[pid] = on_done
+        return pid
+
     def pump_close(self, pump_id: int) -> None:
         vtl.LIB.vtl_pump_close(self._lp, pump_id)
 

@@ -73,6 +73,14 @@ def _load() -> ctypes.CDLL:
     lib.vtl_pump_stat.argtypes = [p, u64, ctypes.POINTER(u64)]
     lib.vtl_pump_close.argtypes = [p, u64]
     lib.vtl_pump_free.argtypes = [p, u64]
+    i64 = ctypes.c_longlong
+    lib.vtl_tls_init.argtypes = []
+    lib.vtl_tls_ctx_new.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.vtl_tls_ctx_new.restype = i64
+    lib.vtl_tls_ctx_free.argtypes = [i64]
+    lib.vtl_tls_pump_new.argtypes = [p, c, c, c, i64]
+    lib.vtl_tls_pump_new.restype = u64
+    lib.vtl_recv_peek.argtypes = [c, ctypes.c_void_p, c]
     return lib
 
 
@@ -262,3 +270,41 @@ def enable_fdtrace() -> None:
 
 if os.environ.get("VPROXY_TPU_FDTRACE", "") == "1":
     enable_fdtrace()
+
+
+# ----------------------------------------------------------- native TLS
+#
+# OpenSSL (libssl.so.3, dlopen'd by the native layer) terminating TLS
+# INSIDE the splice pump: the reference runs SSLEngine wrap/unwrap at
+# engine speed (SSLWrapRingBuffer.java:23 / SSLUnwrapRingBuffer.java:28);
+# here the handshake + record layer run in C against the client fd while
+# plaintext rides the same pump rings — TLS bytes never enter Python.
+
+def tls_available() -> bool:
+    """Native TLS pump usable? (native provider + libssl resolvable)."""
+    if LIB is None:
+        return False
+    return LIB.vtl_tls_init() == 0
+
+
+def tls_ctx_new(cert_path: str, key_path: str) -> int:
+    """-> native SSL_CTX handle; raises on bad cert/key."""
+    h = LIB.vtl_tls_ctx_new(cert_path.encode(), key_path.encode())
+    if h < 0:
+        raise OSError(-h, f"tls ctx: {os.strerror(int(-h))}")
+    return int(h)
+
+
+def tls_ctx_free(handle: int) -> None:
+    if LIB is not None and handle:
+        LIB.vtl_tls_ctx_free(handle)
+
+
+def recv_peek(fd: int, maxlen: int = 16384):
+    """MSG_PEEK read (bytes stay queued); None on EAGAIN."""
+    buf = ctypes.create_string_buffer(maxlen)
+    n = LIB.vtl_recv_peek(fd, buf, maxlen)
+    if n == AGAIN:
+        return None
+    check(n)
+    return buf.raw[:n]
