@@ -953,18 +953,23 @@ def orchestrate():
     smoke_env = dict(SMOKE_ENV)
     smoke = _run_stage("tpu-smoke", smoke_env, smoke_timeout, phase_file)
     attempt = 0
+    # verification-gated lowering ladder: fastest first, r4-verified last
+    MODE_LADDER = {"reduce": "selgather", "selgather": "gather"}
     while not (usable(smoke) and smoke.get("platform") != "cpu"):
+        cur_mode = smoke_env.get("VPROXY_TPU_FP_MEMBER", "gather")
         if (smoke is not None and smoke.get("value", 0) > 0
                 and smoke.get("platform") != "cpu"
                 and not (smoke.get("chk_ok") and smoke.get("oracle_ok"))
-                and smoke_env.get("VPROXY_TPU_FP_MEMBER") != "gather"
+                and cur_mode in MODE_LADDER
                 and budget - (time.time() - t_start) > smoke_timeout + 120):
             # device up but verification FAILED: the backend miscompiled
-            # the default member-eval lowering — fall back to the
-            # verified-safe gather forms instead of burning retries
-            sys.stderr.write("# tpu-smoke verification failed; falling "
-                             "back to VPROXY_TPU_FP_MEMBER=gather\n")
-            smoke_env["VPROXY_TPU_FP_MEMBER"] = "gather"
+            # this member-eval lowering — step down the ladder toward
+            # the verified-safe gather forms instead of burning retries
+            nxt = MODE_LADDER[cur_mode]
+            sys.stderr.write(f"# tpu-smoke verification failed on "
+                             f"{cur_mode}; retrying with "
+                             f"VPROXY_TPU_FP_MEMBER={nxt}\n")
+            smoke_env["VPROXY_TPU_FP_MEMBER"] = nxt
             smoke = _run_stage("tpu-smoke", smoke_env, smoke_timeout,
                                phase_file)
             continue
@@ -986,14 +991,17 @@ def orchestrate():
             full_env = {k: v for k, v in smoke_env.items()
                         if k == "VPROXY_TPU_FP_MEMBER"}
             full = _run_stage("tpu-full", full_env, remaining, phase_file)
-            if (full is not None and full.get("value", 0) > 0
-                    and not (full.get("chk_ok") and full.get("oracle_ok"))
-                    and full_env.get("VPROXY_TPU_FP_MEMBER") != "gather"
-                    and budget - (time.time() - t_start) > 120):
-                # full-size shapes can fuse differently: same fallback
-                sys.stderr.write("# tpu-full verification failed; "
-                                 "retrying with gather member mode\n")
-                full_env["VPROXY_TPU_FP_MEMBER"] = "gather"
+            while (full is not None and full.get("value", 0) > 0
+                   and not (full.get("chk_ok") and full.get("oracle_ok"))
+                   and full_env.get("VPROXY_TPU_FP_MEMBER", "gather")
+                   in MODE_LADDER
+                   and budget - (time.time() - t_start) > 120):
+                # full-size shapes can fuse differently: same ladder
+                nxt = MODE_LADDER[full_env.get("VPROXY_TPU_FP_MEMBER",
+                                               "gather")]
+                sys.stderr.write(f"# tpu-full verification failed; "
+                                 f"retrying with {nxt} member mode\n")
+                full_env["VPROXY_TPU_FP_MEMBER"] = nxt
                 full = _run_stage(
                     "tpu-full", full_env,
                     budget - (time.time() - t_start) - 15, phase_file)
