@@ -227,7 +227,7 @@ class TcpLB:
         pump's plaintext never surfaces to the mirror)."""
         from ..utils.mirror import Mirror
         m = Mirror.get()
-        return m.hot and m.wants("tls")
+        return m.hot and m.wants("ssl")  # net/tls.py's mirror origin
 
     def _serve_tls_native(self, loop, cfd: int, ip: str, port: int) -> None:
         """Peek the ClientHello (bytes stay queued), choose the cert and
