@@ -279,3 +279,33 @@ def test_fuzz_socks5_live_handshake():
         g.close()
         backend.close()
         elg.close()
+
+
+def test_fuzz_client_hello_sni_parser():
+    """The SNI sniffer runs on the accept path against raw client bytes:
+    it must return (sni|None, bool) for ANY input — no exception is
+    acceptable (a crash here would kill the accept handler)."""
+    import ssl
+
+    from vproxy_tpu.net.sniff import parse_client_hello_sni
+
+    # a REAL ClientHello via a MemoryBIO handshake attempt
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    inb, outb = ssl.MemoryBIO(), ssl.MemoryBIO()
+    obj = ctx.wrap_bio(inb, outb, server_hostname="fuzz.example.com")
+    try:
+        obj.do_handshake()
+    except ssl.SSLWantReadError:
+        pass
+    hello = outb.read()
+    sni, complete = parse_client_hello_sni(hello)
+    assert complete and sni == "fuzz.example.com"
+    # every truncation prefix must be total (no exception), and short
+    # prefixes must report incomplete rather than a bogus verdict
+    for i in range(len(hello)):
+        parse_client_hello_sni(hello[:i])
+    for blob in corpus(hello):
+        out = parse_client_hello_sni(blob)
+        assert isinstance(out, tuple) and len(out) == 2
