@@ -19,8 +19,19 @@ Env flags (the reference's -D system-property layer, Config.java):
   VPROXY_TPU_PROBE=ch1,ch2               targeted data-path probe channels
   VPROXY_TPU_FDTRACE=1                   trace every FD syscall (-Dvfdtrace)
   VPROXY_TPU_MATCHER=...                 classify backend override
+  VPROXY_TPU_FP_MEMBER=gather|selgather|reduce
+                                         fp-kernel member-eval lowering
   VPROXY_TPU_WORKERS=n                   default worker loop count
   VPROXY_TPU_HOME=dir                    config/persistence directory
+  VPROXY_TPU_FD_PROVIDER=native|py       socket/pump backend
+  VPROXY_TPU_NATIVE_TLS=0                force python TLS (MemoryBIO)
+  VPROXY_TPU_SWITCH_FASTPATH=0           force object-path switch
+  VPROXY_TPU_FASTPATH_MIN=n              burst floor for the fast path
+  VPROXY_TPU_CLASSIFY=auto|device|host   dispatch-path policy
+  VPROXY_TPU_CLASSIFY_BUDGET_US=n        lone-query latency budget
+  VPROXY_TPU_DIST_COORD=host:port        jax.distributed coordinator
+  VPROXY_TPU_DIST_NPROC=n                ... process count
+  VPROXY_TPU_DIST_PROCID=i               ... this process's id
 """
 from __future__ import annotations
 
