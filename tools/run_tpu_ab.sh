@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot on-chip member-mode A/B + headline + 200k proof.
+# Run from the repo root in the DEFAULT env (tunnel attached), one TPU
+# client at a time. Artifacts land in .ab_* result files; inspect, then
+# copy the winners to BENCH_r05_builder*.json and commit.
+set -u
+cd "$(dirname "$0")/.."
+PH=.ab_phases.jsonl
+rm -f "$PH"
+
+run_child() {  # name, extra env...
+  local name=$1; shift
+  echo "=== $name ==="
+  env BENCH_STAGE="$name" BENCH_PHASE_FILE="$PH" \
+      BENCH_RESULT_FILE=".ab_$name.json" "$@" \
+      timeout -k 60 900 python bench.py --child
+  echo "--- $name result:"; cat ".ab_$name.json" 2>/dev/null; echo
+}
+
+SMOKE="env BENCH_RULES=1000 BENCH_ROUTES=500 BENCH_ACLS=200 BENCH_BATCH=512 \
+BENCH_STEPS_PER_DISPATCH=1024 BENCH_ITERS=32 BENCH_E2E_ITERS=4 \
+BENCH_QUERY_SETS=2 BENCH_LAT_ITERS=16 BENCH_SVC_THREADS=4 \
+BENCH_SVC_QUERIES=10 BENCH_SVC_POLICY_QUERIES=50 BENCH_CHILD_BUDGET=240"
+
+# 1) smoke-scale verification+rate per lowering (compile-cache-cheap)
+for MODE in reduce selgather gather; do
+  run_child "ab-smoke-$MODE" $SMOKE VPROXY_TPU_FP_MEMBER="$MODE"
+done
+
+echo "*** pick the fastest mode with chk_ok+oracle_ok above, then:"
+echo "  env VPROXY_TPU_FP_MEMBER=<mode> BENCH_CHILD_BUDGET=900 \\"
+echo "      BENCH_STAGE=full BENCH_RESULT_FILE=.ab_full.json \\"
+echo "      timeout -k 60 1200 python bench.py --child"
+echo "  # 200k proof:"
+echo "  env VPROXY_TPU_FP_MEMBER=<mode> BENCH_RULES=200000 \\"
+echo "      BENCH_ROUTES=100000 BENCH_ACLS=10000 BENCH_BATCH=8192 \\"
+echo "      BENCH_STAGE=full200k BENCH_RESULT_FILE=.ab_200k.json \\"
+echo "      BENCH_CHILD_BUDGET=900 timeout -k 60 1200 python bench.py --child"
