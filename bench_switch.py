@@ -14,10 +14,21 @@ Reported (merged into bench.py output):
                              backend = default / VPROXY_TPU_MATCHER)
   switch_replay_pps_oracle — same replay, host-oracle matchers (the
                              reference-style per-packet linear scan)
+  switch_socket_loopback_pps — the FULL socket pipeline (a sendmmsg
+                             blaster -> the switch's real UDP sock ->
+                             recvmmsg drain -> fast path -> sendmmsg
+                             egress), measured as switch-egressed
+                             datagrams/s. On loopback this is KERNEL-
+                             bound (~10-15us per datagram through the
+                             UDP stack, paid twice) — a bound shared by
+                             any userspace UDP switch — so it reflects
+                             the environment, not the data plane (the
+                             replay metric isolates the data plane)
   switch_routes / switch_acls / switch_burst / switch_pkts
 
 Env knobs: SWBENCH_ROUTES (50000), SWBENCH_ACLS (5000), SWBENCH_SECS
-(6), SWBENCH_PKTS (4096), SWBENCH_ORACLE_SECS (3).
+(6), SWBENCH_PKTS (4096), SWBENCH_ORACLE_SECS (3), SWBENCH_SOCK_SECS
+(4).
 """
 import json
 import os
@@ -147,6 +158,132 @@ def replay(loop, sw, counter, dgrams, secs):
     return n_in, counter.sent, dt
 
 
+def socket_pipeline(loop, sw, dgrams, secs):
+    """Blast the replay set at the switch's REAL UDP socket and count
+    egressed datagrams at a receiver socket (both sides mmsg-batched).
+    The blaster + receiver run in a SUBPROCESS so the generator never
+    steals the switch loop's GIL. UDP drops under pressure are expected
+    — the receiver count is the honest delivered rate."""
+    import subprocess
+    import tempfile
+
+    from vproxy_tpu.net import vtl
+    from vproxy_tpu.vswitch.iface import BareVXLanIface
+
+    if vtl.PROVIDER != "native":
+        return None
+    with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+        for d, _, _ in dgrams:
+            f.write(len(d).to_bytes(4, "little") + d)
+        corpus = f.name
+    try:
+        child = None
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--blast",
+             str(sw.bind_port), str(secs), corpus],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        rx_port = int(json.loads(child.stdout.readline())["rx_port"])
+        # point the egress mac at a COUNTING bare iface toward the
+        # receiver: the headline is what the switch egresses (kernel-
+        # accepted sendmmsg count); the receiver's own count is a
+        # secondary signal since its drain thread shares the blaster's
+        # GIL and can starve under flood
+        dst_mac = b"\x02\xfe\x00\x00\x00\x01"
+
+        class CountingBare(BareVXLanIface):
+            egressed = 0
+
+            def send_vxlan_raw_many(self, sw2, datas):
+                CountingBare.egressed += sw2.send_udp_many(datas,
+                                                           self.remote)
+
+            def send_vxlan_raw(self, sw2, data):
+                if sw2.send_udp_many([data], self.remote):
+                    CountingBare.egressed += 1
+
+        out_iface = CountingBare("127.0.0.1", rx_port)
+
+        def repoint():
+            for net in sw.networks.values():
+                if net.macs.lookup(dst_mac) is not None:
+                    net.macs.record(dst_mac, out_iface)
+        loop.call_sync(repoint, timeout=30)
+        child.stdin.write("go\n")
+        child.stdin.flush()
+        out, _ = child.communicate(timeout=secs + 60)
+        r = json.loads(out.strip().splitlines()[-1])
+        return {"switch_socket_sent": r["sent"],
+                "switch_socket_egressed": CountingBare.egressed,
+                "switch_socket_rx": r["rx"],
+                "switch_socket_loopback_pps": round(
+                    CountingBare.egressed / r["secs"], 1),
+                "switch_socket_sent_pps": r["sent_pps"]}
+    finally:
+        if child is not None and child.poll() is None:
+            child.kill()  # error paths must not orphan the blaster
+            try:
+                child.wait(5)
+            except subprocess.TimeoutExpired:
+                pass
+        try:
+            os.unlink(corpus)
+        except OSError:
+            pass
+
+
+def blast_main(switch_port: int, secs: float, corpus: str) -> int:
+    """--blast child: receiver + sendmmsg generator (own process)."""
+    import threading
+
+    from vproxy_tpu.net import vtl
+
+    datas = []
+    with open(corpus, "rb") as f:
+        raw = f.read()
+    o = 0
+    while o < len(raw):
+        ln = int.from_bytes(raw[o: o + 4], "little")
+        datas.append(raw[o + 4: o + 4 + ln])
+        o += 4 + ln
+    rx = vtl.udp_bind("127.0.0.1", 0)
+    _, rport = vtl.sock_name(rx)
+    vtl.set_rcvbuf(rx, 8 << 20)
+    print(json.dumps({"rx_port": rport}), flush=True)
+    sys.stdin.readline()  # wait for the parent's "go"
+    stop = [False]
+    rx_count = [0]
+
+    def drain():
+        while not stop[0]:
+            got = vtl.recvmmsg(rx)
+            if not got:
+                time.sleep(0.0005)
+                continue
+            rx_count[0] += len(got)
+
+    th = threading.Thread(target=drain, daemon=True)
+    th.start()
+    tx = vtl.udp_socket()
+    sent = 0
+    t0 = time.perf_counter()
+    deadline = t0 + secs
+    while time.perf_counter() < deadline:
+        for i in range(0, len(datas), 128):
+            n = vtl.sendmmsg(tx, datas[i: i + 128], "127.0.0.1",
+                             switch_port)
+            sent += n
+            if n < min(128, len(datas) - i):
+                time.sleep(0.0002)  # switch rcvbuf full: brief backoff
+    time.sleep(0.3)  # pipeline flush
+    dt = time.perf_counter() - t0
+    stop[0] = True
+    th.join(2)
+    print(json.dumps({"sent": sent, "rx": rx_count[0], "secs": dt,
+                      "sent_pps": round(sent / dt, 1),
+                      "rx_pps": round(rx_count[0] / dt, 1)}), flush=True)
+    return 0
+
+
 def main():
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     secs = float(os.environ.get("SWBENCH_SECS", "6"))
@@ -178,6 +315,13 @@ def main():
         result["switch_replay_secs"] = round(dt, 2)
         flush()
 
+        sock = socket_pipeline(loop, sw, dgrams,
+                               float(os.environ.get("SWBENCH_SOCK_SECS",
+                                                    "4")))
+        if sock:
+            result.update(sock)
+            flush()
+
         # reference-style per-packet linear scan for context
         loop2, sw2, counter2, dgrams2 = build_world(backend="host")
         loops.append((loop2, sw2))
@@ -201,4 +345,7 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 5 and sys.argv[1] == "--blast":
+        sys.exit(blast_main(int(sys.argv[2]), float(sys.argv[3]),
+                            sys.argv[4]))
     sys.exit(main())

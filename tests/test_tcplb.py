@@ -186,7 +186,7 @@ def test_session_and_connection_listing(stack):
         c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
         c.settimeout(5)
         assert c.recv(10) == b"S"
-        deadline = time.time() + 5
+        deadline = time.time() + 15
         rows = []
         while time.time() < deadline:
             rows = Command.execute(app, "list-detail session in tcp-lb lb")
@@ -202,7 +202,7 @@ def test_session_and_connection_listing(stack):
         socks = Command.execute(app, "list-detail server-sock in tcp-lb lb")
         assert socks == [f"127.0.0.1:{lb.bind_port} -> loop {elg.loops[0].name}"]
         c.close()
-        deadline = time.time() + 5
+        deadline = time.time() + 15
         while time.time() < deadline and lb.active_sessions:
             time.sleep(0.02)
         assert Command.execute(app, "list session in tcp-lb lb") == ["0"]

@@ -215,6 +215,74 @@ int vtl_sendto(int fd, const void* buf, int len, const char* ip, int port,
   return n < 0 ? -errno : (int)n;
 }
 
+// Batched datagram ingress: one syscall (and one ctypes crossing)
+// drains up to `maxmsgs` datagrams into `buf` sliced as fixed `slot`-
+// byte cells. lens[i] = datagram size (truncated to slot), ips is a
+// maxmsgs x ipstride char matrix, ports[i] the sender port. Returns
+// message count, 0 on EAGAIN, -errno on error.
+int vtl_recvmmsg(int fd, void* buf, int slot, int maxmsgs, int* lens,
+                 char* ips, int ipstride, int* ports) {
+  if (maxmsgs > 512) maxmsgs = 512;
+  static thread_local mmsghdr hdrs[512];
+  static thread_local iovec iovs[512];
+  static thread_local sockaddr_storage addrs[512];
+  for (int i = 0; i < maxmsgs; ++i) {
+    iovs[i].iov_base = (char*)buf + (size_t)i * slot;
+    iovs[i].iov_len = (size_t)slot;
+    memset(&hdrs[i].msg_hdr, 0, sizeof(msghdr));
+    hdrs[i].msg_hdr.msg_iov = &iovs[i];
+    hdrs[i].msg_hdr.msg_iovlen = 1;
+    hdrs[i].msg_hdr.msg_name = &addrs[i];
+    hdrs[i].msg_hdr.msg_namelen = sizeof(sockaddr_storage);
+  }
+  int n = recvmmsg(fd, hdrs, (unsigned)maxmsgs, MSG_DONTWAIT, nullptr);
+  if (n < 0) return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -errno;
+  for (int i = 0; i < n; ++i) {
+    lens[i] = (int)hdrs[i].msg_len;
+    char* ip = ips + (size_t)i * ipstride;
+    ip[0] = 0;
+    ports[i] = 0;
+    if (addrs[i].ss_family == AF_INET) {
+      auto* a = (sockaddr_in*)&addrs[i];
+      inet_ntop(AF_INET, &a->sin_addr, ip, ipstride);
+      ports[i] = ntohs(a->sin_port);
+    } else if (addrs[i].ss_family == AF_INET6) {
+      auto* a = (sockaddr_in6*)&addrs[i];
+      inet_ntop(AF_INET6, &a->sin6_addr, ip, ipstride);
+      ports[i] = ntohs(a->sin6_port);
+    }
+  }
+  return n;
+}
+
+// Batched same-destination egress (the fast path's per-iface groups):
+// one sendmmsg for n datagrams given as (ptrs[i], lens[i]). Returns
+// the number actually sent (datagram sockets: the rest were dropped
+// by buffer pressure — acceptable for a switch) or -errno.
+int vtl_sendmmsg(int fd, const void* const* ptrs, const int* lens, int n,
+                 const char* ip, int port, int v6) {
+  if (n > 512) n = 512;
+  sockaddr_storage ss;
+  socklen_t slen;
+  int r = mk_addr(ip, port, v6, &ss, &slen);
+  if (r < 0) return r;
+  static thread_local mmsghdr hdrs[512];
+  static thread_local iovec iovs[512];
+  for (int i = 0; i < n; ++i) {
+    iovs[i].iov_base = (void*)ptrs[i];
+    iovs[i].iov_len = (size_t)lens[i];
+    memset(&hdrs[i].msg_hdr, 0, sizeof(msghdr));
+    hdrs[i].msg_hdr.msg_iov = &iovs[i];
+    hdrs[i].msg_hdr.msg_iovlen = 1;
+    hdrs[i].msg_hdr.msg_name = &ss;
+    hdrs[i].msg_hdr.msg_namelen = slen;
+  }
+  int sent = sendmmsg(fd, hdrs, (unsigned)n, 0);
+  if (sent < 0)
+    return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -errno;
+  return sent;
+}
+
 int vtl_read(int fd, void* buf, int len) {
   ssize_t n = read(fd, buf, (size_t)len);
   return n < 0 ? -errno : (int)n;
@@ -228,6 +296,11 @@ int vtl_write(int fd, const void* buf, int len) {
 int vtl_close(int fd) { return close(fd) < 0 ? -errno : 0; }
 
 int vtl_shutdown_wr(int fd) { return shutdown(fd, SHUT_WR) < 0 ? -errno : 0; }
+
+int vtl_set_rcvbuf(int fd, int bytes) {
+  return setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes)) < 0
+             ? -errno : 0;
+}
 
 int vtl_set_nodelay(int fd, int on) {
   return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &on, sizeof(on)) < 0

@@ -67,6 +67,7 @@ def _load() -> ctypes.CDLL:
     lib.vtl_close.argtypes = [c]
     lib.vtl_shutdown_wr.argtypes = [c]
     lib.vtl_set_nodelay.argtypes = [c, c]
+    lib.vtl_set_rcvbuf.argtypes = [c, c]
     lib.vtl_sock_name.argtypes = [c, c, ctypes.c_char_p, c, ctypes.POINTER(c)]
     lib.vtl_pump_new.argtypes = [p, c, c, c]
     lib.vtl_pump_new.restype = u64
@@ -81,6 +82,12 @@ def _load() -> ctypes.CDLL:
     lib.vtl_tls_pump_new.argtypes = [p, c, c, c, i64]
     lib.vtl_tls_pump_new.restype = u64
     lib.vtl_recv_peek.argtypes = [c, ctypes.c_void_p, c]
+    lib.vtl_recvmmsg.argtypes = [c, ctypes.c_void_p, c, c,
+                                 ctypes.POINTER(c), ctypes.c_char_p, c,
+                                 ctypes.POINTER(c)]
+    lib.vtl_sendmmsg.argtypes = [c, ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(c), c, ctypes.c_char_p,
+                                 c, c]
     return lib
 
 
@@ -196,6 +203,11 @@ def shutdown_wr(fd: int) -> None:
     LIB.vtl_shutdown_wr(fd)
 
 
+def set_rcvbuf(fd: int, nbytes: int) -> None:
+    """Best-effort receive-buffer sizing (bursty UDP ingress)."""
+    LIB.vtl_set_rcvbuf(fd, nbytes)
+
+
 def set_nodelay(fd: int, on: bool = True) -> None:
     LIB.vtl_set_nodelay(fd, 1 if on else 0)
 
@@ -308,3 +320,67 @@ def recv_peek(fd: int, maxlen: int = 16384):
         return None
     check(n)
     return buf.raw[:n]
+
+
+# -------------------------------------------------------- batched UDP
+#
+# One syscall + one ctypes crossing per BURST instead of per datagram:
+# the switch's ingress drain and the fast path's per-iface egress
+# groups are syscall-bound once the per-packet work is vectorized.
+
+_MMSG_SLOT = 65536  # any legal UDP datagram fits whole (no truncation)
+_MMSG_MAX = 64
+_mmsg_tls = None  # lazy threading.local: every receiver thread gets
+                  # its own buffers (the ctypes call releases the GIL,
+                  # so a shared buffer would tear between threads)
+
+
+def recvmmsg(fd: int):
+    """-> [(data, ip, port), ...] (possibly empty on EAGAIN)."""
+    global _mmsg_tls
+    if _mmsg_tls is None:
+        import threading
+        _mmsg_tls = threading.local()
+    b = getattr(_mmsg_tls, "bufs", None)
+    if b is None:
+        b = _mmsg_tls.bufs = (
+            ctypes.create_string_buffer(_MMSG_SLOT * _MMSG_MAX),
+            (ctypes.c_int * _MMSG_MAX)(),
+            ctypes.create_string_buffer(64 * _MMSG_MAX),
+            (ctypes.c_int * _MMSG_MAX)())
+    buf, lens, ips, ports = b
+    n = LIB.vtl_recvmmsg(fd, buf, _MMSG_SLOT, _MMSG_MAX, lens, ips, 64,
+                         ports)
+    if n <= 0:
+        check(n)
+        return []
+    base = ctypes.addressof(buf)
+    out = []
+    for i in range(n):
+        # string_at copies only the received bytes (buf.raw would copy
+        # the whole 2MB buffer per call)
+        ip = ips[64 * i: 64 * (i + 1)].split(b"\0", 1)[0].decode()
+        out.append((ctypes.string_at(base + i * _MMSG_SLOT, lens[i]),
+                    ip, ports[i]))
+    return out
+
+
+def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:
+    """Send many datagrams to ONE destination; -> count accepted."""
+    n = len(datas)
+    sent_total = 0
+    ipb = ip.encode()
+    v6 = 1 if ":" in ip else 0
+    i = 0
+    while i < n:
+        chunk = datas[i: i + 512]
+        ptrs = (ctypes.c_char_p * len(chunk))(*chunk)
+        lens = (ctypes.c_int * len(chunk))(*[len(d) for d in chunk])
+        r = LIB.vtl_sendmmsg(fd, ptrs, lens, len(chunk), ipb, port, v6)
+        if r < 0:
+            check(r)
+        sent_total += r
+        if r < len(chunk):
+            break  # buffer pressure: remaining datagrams dropped
+        i += len(chunk)
+    return sent_total

@@ -209,6 +209,16 @@ def shutdown_wr(fd: int) -> None:
         pass
 
 
+def set_rcvbuf(fd: int, nbytes: int) -> None:
+    import os as _os
+    import socket as _s
+    try:
+        _s.socket(fileno=_os.dup(fd)).setsockopt(
+            _s.SOL_SOCKET, _s.SO_RCVBUF, nbytes)
+    except OSError:
+        pass
+
+
 def set_nodelay(fd: int, on: bool = True) -> None:
     s = _socks.get(fd)
     try:

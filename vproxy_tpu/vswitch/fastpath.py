@@ -302,6 +302,14 @@ class SwitchFastPath:
         lens_l = row_lens.tolist()
         for u in np.unique(if_idx):
             out = ifaces[int(u)]
+            many = getattr(out, "send_vxlan_raw_many", None)
+            if many is not None:
+                datas = [blk[j * w: j * w + lens_l[j]]
+                         for j in np.nonzero(if_idx == u)[0].tolist()
+                         if row_if is None or out is not row_if[rows_l[j]]]
+                if datas:
+                    many(sw, datas)  # one sendmmsg per iface group
+                continue
             raw = out.send_vxlan_raw
             for j in np.nonzero(if_idx == u)[0].tolist():
                 if row_if is not None and out is row_if[rows_l[j]]:

@@ -51,6 +51,9 @@ class BareVXLanIface(Iface):
     def send_vxlan_raw(self, sw, data: bytes) -> None:
         sw.send_udp(data, self.remote)
 
+    def send_vxlan_raw_many(self, sw, datas: list) -> None:
+        sw.send_udp_many(datas, self.remote)
+
 
 class RemoteSwitchIface(Iface):
     """Link to another vproxy-style switch (plain VXLAN, any vni)."""
@@ -66,6 +69,9 @@ class RemoteSwitchIface(Iface):
 
     def send_vxlan_raw(self, sw, data: bytes) -> None:
         sw.send_udp(data, self.remote)
+
+    def send_vxlan_raw_many(self, sw, datas: list) -> None:
+        sw.send_udp_many(datas, self.remote)
 
 
 class UserIface(Iface):

@@ -110,6 +110,9 @@ class Switch:
     def _bind(self, loop) -> None:
         def mk() -> None:
             self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
+            # bursty VXLAN ingress: the default ~200KB rcvbuf holds only
+            # a few hundred datagrams — absorb whole bursts instead
+            vtl.set_rcvbuf(self._fd, 4 << 20)
             if self.bind_port == 0:
                 _, self.bind_port = vtl.sock_name(self._fd)
             loop.add(self._fd, vtl.EV_READ, self._on_readable)
@@ -312,6 +315,23 @@ class Switch:
             except OSError:
                 pass
 
+    def send_udp_many(self, datas: list, remote: tuple[str, int]) -> int:
+        """Batched same-destination egress (fast-path groups): one
+        sendmmsg when the native provider offers it. -> count accepted
+        by the kernel (datagram drops under pressure are normal)."""
+        if self._fd is None:
+            return 0
+        try:
+            if vtl.PROVIDER == "native" and hasattr(vtl, "sendmmsg"):
+                return vtl.sendmmsg(self._fd, datas, remote[0], remote[1])
+            n = 0
+            for d in datas:
+                vtl.sendto(self._fd, d, remote[0], remote[1])
+                n += 1
+            return n
+        except OSError:
+            return 0
+
     def _register(self, key, iface: Iface, permanent: bool = False):
         self._reg_version += 1
         self.ifaces[key] = (iface, float("inf") if permanent else time.monotonic())
@@ -372,13 +392,21 @@ class Switch:
         (Switch.java:629-799); here the burst is the unit so the 5k-rule
         bare ACL and 50k-route LPM cost ONE device dispatch each per
         burst, not per packet."""
+        batched = vtl.PROVIDER == "native" and hasattr(vtl, "recvmmsg")
         while self._fd is not None:
             burst = []
-            while len(burst) < self.RECV_BURST:
-                r = vtl.recvfrom(fd)
-                if r is None:
-                    break
-                burst.append(r)
+            if batched:  # one syscall per up-to-128 datagrams
+                while len(burst) < self.RECV_BURST:
+                    got = vtl.recvmmsg(fd)
+                    if not got:
+                        break
+                    burst.extend(got)
+            else:
+                while len(burst) < self.RECV_BURST:
+                    r = vtl.recvfrom(fd)
+                    if r is None:
+                        break
+                    burst.append(r)
             if not burst:
                 return
             self._input_batch(burst)
