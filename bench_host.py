@@ -56,8 +56,11 @@ def start_server():
     return p, port
 
 
-def run_client(port, conns, secs, pipeline, tls_sni=None):
-    if tls_sni is None:
+def run_client(port, conns, secs, pipeline, tls_sni=None, short=False):
+    if short:
+        cmd = [BIN, "shortclient", "127.0.0.1", str(port), str(conns),
+               str(secs)]
+    elif tls_sni is None:
         cmd = [BIN, "client", "127.0.0.1", str(port), str(conns),
                str(secs), str(pipeline)]
     else:
@@ -152,6 +155,24 @@ def main():
             finally:
                 lb.stop()
                 lb = None
+
+        # short connections (connection-per-request): the accept path —
+        # ACL + classify + backend pick + pump setup/teardown per req.
+        # Reference row: 6,511 req/s (bench.md:19, its hardware).
+        lb = TcpLB("lb-short", acceptor, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp")
+        lb.start()
+        try:
+            run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+            r = run_client(lb.bind_port, conns, secs, 1, short=True)
+            result["host_tcp_short_rps"] = r["rps"]
+            result["host_tcp_short_errors"] = r["errors"]
+            result["host_short_vs_ref_6511"] = round(
+                r["rps"] / 6511.3, 3)
+            flush()
+        finally:
+            lb.stop()
+            lb = None
 
         # TLS-terminating protocol=tcp: the C-side OpenSSL splice pump
         # (SSLWrapRingBuffer-at-engine-speed analog). Contract: within

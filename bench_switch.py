@@ -274,8 +274,8 @@ def blast_main(switch_port: int, secs: float, corpus: str) -> int:
             sent += n
             if n < min(128, len(datas) - i):
                 time.sleep(0.0002)  # switch rcvbuf full: brief backoff
-    time.sleep(0.3)  # pipeline flush
-    dt = time.perf_counter() - t0
+    dt = time.perf_counter() - t0  # send window only (honest sent_pps)
+    time.sleep(0.3)  # pipeline flush (egress/rx counters keep counting)
     stop[0] = True
     th.join(2)
     print(json.dumps({"sent": sent, "rx": rx_count[0], "secs": dt,

@@ -33,6 +33,72 @@ from .upstream import Upstream
 _log = Logger("tcp-lb")
 
 
+class _SpliceBack(Handler):
+    """Backend-connect handler for the splice path — ONE shared class
+    (defining it per accept showed up as __build_class__ on the
+    short-connection profile)."""
+
+    __slots__ = ("lb", "loop", "front_fd", "target", "head", "front",
+                 "_pid", "tls_ctx")
+
+    def __init__(self, lb, loop, front_fd: int, target: Connector,
+                 head: bytes, front: str, tls_ctx: int = 0):
+        self.lb = lb
+        self.loop = loop
+        self.front_fd = front_fd
+        self.target = target
+        self.head = head
+        self.front = front
+        self._pid = None
+        self.tls_ctx = tls_ctx  # nonzero: TLS-terminating pump
+
+    def on_connected(self, conn: Connection) -> None:
+        # do NOT consume early backend bytes (100-continue, early
+        # errors): leave them queued in the kernel for the pump
+        conn.pause_reading()
+        if self.head:
+            conn.write(self.head)
+        if conn.out:
+            # wait for drain before pump handover
+            return
+        self._handover(conn)
+
+    def on_drained(self, conn: Connection) -> None:
+        self._handover(conn)
+
+    def _handover(self, conn: Connection) -> None:
+        if conn.detached or conn.closed:
+            return
+        bfd = conn.detach()
+        vtl.set_nodelay(self.front_fd)
+        vtl.set_nodelay(bfd)
+        if self.tls_ctx:
+            pid = self.loop.pump_tls(self.front_fd, bfd, self.tls_ctx,
+                                     self.lb.in_buffer_size, self._done)
+        else:
+            pid = self.loop.pump(self.front_fd, bfd,
+                                 self.lb.in_buffer_size, self._done)
+        self._pid = pid
+        self.lb._watch_pump(
+            self.loop, pid,
+            f"{self.front} -> {self.target.ip}:{self.target.port}")
+
+    def _done(self, a2b: int, b2a: int, err: int) -> None:
+        lb, svr = self.lb, self.target.svr
+        lb._unwatch_pump(self.loop, self._pid)
+        lb.bytes_in += a2b
+        lb.bytes_out += b2a
+        svr.bytes_in += a2b
+        svr.bytes_out += b2a
+        svr.conn_count -= 1
+        lb.active_sessions -= 1
+
+    def on_closed(self, conn: Connection, err: int) -> None:
+        self.target.svr.conn_count -= 1
+        self.lb.active_sessions -= 1
+        vtl.close(self.front_fd)
+
+
 class TcpLB:
     def __init__(self, alias: str, acceptor: EventLoopGroup,
                  worker: EventLoopGroup, bind_ip: str, bind_port: int,
@@ -337,7 +403,6 @@ class TcpLB:
                     ctx: int, front: str = "?") -> None:
         """Like _splice, but the handover runs the TLS-terminating pump
         (client side TLS in C, backend plaintext)."""
-        lb = self
         svr = target.svr
         svr.conn_count += 1
         self.active_sessions += 1
@@ -348,39 +413,8 @@ class TcpLB:
             self.active_sessions -= 1
             vtl.close(front_fd)
             return
-
-        class Back(Handler):
-            def on_connected(self, conn: Connection) -> None:
-                conn.pause_reading()
-                self._handover(conn)
-
-            def _handover(self, conn: Connection) -> None:
-                if conn.detached or conn.closed:
-                    return
-                bfd = conn.detach()
-                vtl.set_nodelay(front_fd)
-                vtl.set_nodelay(bfd)
-                pid = loop.pump_tls(front_fd, bfd, ctx, lb.in_buffer_size,
-                                    self._done)
-                self._pid = pid
-                lb._watch_pump(loop, pid,
-                               f"tls {front} -> {target.ip}:{target.port}")
-
-            def _done(self, a2b: int, b2a: int, err: int) -> None:
-                lb._unwatch_pump(loop, getattr(self, "_pid", None))
-                lb.bytes_in += a2b
-                lb.bytes_out += b2a
-                svr.bytes_in += a2b
-                svr.bytes_out += b2a
-                svr.conn_count -= 1
-                lb.active_sessions -= 1
-
-            def on_closed(self, conn: Connection, err: int) -> None:
-                svr.conn_count -= 1
-                lb.active_sessions -= 1
-                vtl.close(front_fd)
-
-        back.set_handler(Back())
+        back.set_handler(_SpliceBack(self, loop, front_fd, target, b"",
+                                     f"tls {front}", tls_ctx=ctx))
 
     # ------------------------------------------------------ idle timeout
 
@@ -506,7 +540,6 @@ class TcpLB:
 
     def _splice(self, loop, front_fd: int, target: Connector,
                 head: bytes, front: str = "?") -> None:
-        lb = self
         svr = target.svr
         svr.conn_count += 1
         self.active_sessions += 1
@@ -517,45 +550,5 @@ class TcpLB:
             self.active_sessions -= 1
             vtl.close(front_fd)
             return
-
-        class Back(Handler):
-            def on_connected(self, conn: Connection) -> None:
-                # do NOT consume early backend bytes (100-continue, early
-                # errors): leave them queued in the kernel for the pump
-                conn.pause_reading()
-                if head:
-                    conn.write(head)
-                if conn.out:
-                    # wait for drain before pump handover
-                    return
-                self._handover(conn)
-
-            def on_drained(self, conn: Connection) -> None:
-                self._handover(conn)
-
-            def _handover(self, conn: Connection) -> None:
-                if conn.detached or conn.closed:
-                    return
-                bfd = conn.detach()
-                vtl.set_nodelay(front_fd)
-                vtl.set_nodelay(bfd)
-                pid = loop.pump(front_fd, bfd, lb.in_buffer_size, self._done)
-                self._pid = pid
-                lb._watch_pump(loop, pid,
-                               f"{front} -> {target.ip}:{target.port}")
-
-            def _done(self, a2b: int, b2a: int, err: int) -> None:
-                lb._unwatch_pump(loop, getattr(self, "_pid", None))
-                lb.bytes_in += a2b
-                lb.bytes_out += b2a
-                svr.bytes_in += a2b
-                svr.bytes_out += b2a
-                svr.conn_count -= 1
-                lb.active_sessions -= 1
-
-            def on_closed(self, conn: Connection, err: int) -> None:
-                svr.conn_count -= 1
-                lb.active_sessions -= 1
-                vtl.close(front_fd)
-
-        back.set_handler(Back())
+        back.set_handler(_SpliceBack(self, loop, front_fd, target, head,
+                                     front))

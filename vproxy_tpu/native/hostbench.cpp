@@ -440,12 +440,127 @@ static int run_tls_client(const char *ip, int port, const char *sni,
     return 0;
 }
 
+// ---------------------------------------------------------- short client
+//
+// Connection-per-request load (the reference's short-connection rows,
+// benchmark/report/2019/06/05/bench.md:19): each slot loops
+// connect -> one request -> full response -> close. Measures the
+// accept path (ACL + classify + backend pick + pump setup/teardown).
+
+static int run_short_client(const char *ip, int port, int nconn,
+                            double secs) {
+    signal(SIGPIPE, SIG_IGN);
+    int ep = epoll_create1(0);
+    long long done = 0, errors = 0;
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, ip, &sa.sin_addr);
+    // state per fd: 0 = connecting (EPOLLOUT pending), 1 = sent/reading
+    static int st[MAXFD];
+
+    auto open_one = [&]() -> bool {
+        int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+        if (fd < 0 || fd >= MAXFD) {
+            if (fd >= 0) close(fd);
+            return false;
+        }
+        int r = connect(fd, (sockaddr *)&sa, sizeof(sa));
+        if (r != 0 && errno != EINPROGRESS) {
+            close(fd);
+            return false;
+        }
+        conns[fd] = Conn{};
+        st[fd] = 0;
+        epoll_event ce{};
+        ce.events = EPOLLOUT;
+        ce.data.fd = fd;
+        epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ce);
+        return true;
+    };
+
+    for (int i = 0; i < nconn; i++)
+        if (!open_one()) errors++;
+
+    char buf[65536];
+    epoll_event evs[256];
+    double t0 = now_s(), tend = t0 + secs;
+    while (now_s() < tend) {
+        int n = epoll_wait(ep, evs, 256, 100);
+        for (int i = 0; i < n; i++) {
+            int fd = evs[i].data.fd;
+            Conn &c = conns[fd];
+            if (evs[i].events & (EPOLLERR | EPOLLHUP)) {
+                drop(ep, fd);
+                errors++;
+                open_one();
+                continue;
+            }
+            if (st[fd] == 0 && (evs[i].events & EPOLLOUT)) {
+                int err = 0;
+                socklen_t el = sizeof(err);
+                getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &el);
+                if (err) {
+                    drop(ep, fd);
+                    errors++;
+                    open_one();
+                    continue;
+                }
+                st[fd] = 1;
+                c.out.assign(REQ, REQ_LEN);
+                if (!flush_out(ep, fd, c)) {
+                    drop(ep, fd);
+                    errors++;
+                    open_one();
+                    continue;
+                }
+                epoll_event ce{};
+                ce.events = EPOLLIN | (c.out.empty() ? 0 : EPOLLOUT);
+                ce.data.fd = fd;
+                epoll_ctl(ep, EPOLL_CTL_MOD, fd, &ce);
+                continue;
+            }
+            if (!(evs[i].events & EPOLLIN)) {
+                if (!flush_out(ep, fd, c)) {
+                    drop(ep, fd);
+                    errors++;
+                    open_one();
+                }
+                continue;
+            }
+            ssize_t r = read(fd, buf, sizeof(buf));
+            if (r == 0 || (r < 0 && errno != EAGAIN && errno != EINTR)) {
+                drop(ep, fd);
+                errors++;
+                open_one();
+                continue;
+            }
+            if (r < 0) continue;
+            c.rxbytes += (size_t)r;
+            if (c.rxbytes >= RESP_LEN) {
+                done++;
+                drop(ep, fd);  // close; fresh connection next
+                open_one();
+            }
+        }
+    }
+    double el = now_s() - t0;
+    printf("{\"reqs\": %lld, \"secs\": %.3f, \"rps\": %.1f, "
+           "\"errors\": %lld, \"conns\": %d, \"pipeline\": 0}\n",
+           done, el, done / el, errors, nconn);
+    fflush(stdout);
+    return 0;
+}
+
 int main(int argc, char **argv) {
     if (argc >= 3 && strcmp(argv[1], "server") == 0)
         return run_server(atoi(argv[2]));
     if (argc >= 7 && strcmp(argv[1], "client") == 0)
         return run_client(argv[2], atoi(argv[3]), atoi(argv[4]),
                           atof(argv[5]), atoi(argv[6]));
+    if (argc >= 6 && strcmp(argv[1], "shortclient") == 0)
+        return run_short_client(argv[2], atoi(argv[3]), atoi(argv[4]),
+                                atof(argv[5]));
     if (argc >= 8 && strcmp(argv[1], "tlsclient") == 0)
         return run_tls_client(argv[2], atoi(argv[3]), argv[4],
                               atoi(argv[5]), atof(argv[6]), atoi(argv[7]));
@@ -453,6 +568,7 @@ int main(int argc, char **argv) {
             "usage: hostbench server <port>\n"
             "       hostbench client <ip> <port> <conns> <secs> <pipeline>\n"
             "       hostbench tlsclient <ip> <port> <sni> <conns> <secs> "
-            "<pipeline>\n");
+            "<pipeline>\n"
+            "       hostbench shortclient <ip> <port> <conns> <secs>\n");
     return 2;
 }

@@ -357,8 +357,8 @@ def recvmmsg(fd: int):
     base = ctypes.addressof(buf)
     out = []
     for i in range(n):
-        # string_at copies only the received bytes (buf.raw would copy
-        # the whole 2MB buffer per call)
+        # string_at copies only the received bytes (buf.raw would
+        # copy the whole slot*max buffer per call)
         ip = ips[64 * i: 64 * (i + 1)].split(b"\0", 1)[0].decode()
         out.append((ctypes.string_at(base + i * _MMSG_SLOT, lens[i]),
                     ip, ports[i]))

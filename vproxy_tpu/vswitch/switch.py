@@ -395,7 +395,7 @@ class Switch:
         batched = vtl.PROVIDER == "native" and hasattr(vtl, "recvmmsg")
         while self._fd is not None:
             burst = []
-            if batched:  # one syscall per up-to-128 datagrams
+            if batched:  # one syscall per up-to-_MMSG_MAX dgrams
                 while len(burst) < self.RECV_BURST:
                     got = vtl.recvmmsg(fd)
                     if not got:
