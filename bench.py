@@ -691,6 +691,7 @@ def service_section(ph, dl):
         assert not errs, errs[:5]
         out[f"service_{tag}_p50_us"] = round(lat["p50_us"], 1)
         out[f"service_{tag}_p99_us"] = round(lat["p99_us"], 1)
+        out[f"service_{tag}_p999_us"] = round(lat.get("p999_us", -1), 1)
         out[f"service_{tag}_max_batch"] = st.max_batch
         out[f"service_{tag}_dispatches"] = st.dispatches
         out[f"service_{tag}_queries"] = st.queries

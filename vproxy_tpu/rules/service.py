@@ -118,14 +118,15 @@ class ClassifyStats:
             self._lat_n += 1
 
     def latency_percentiles(self) -> Optional[dict]:
-        """p50/p99 submit->delivery latency in us over the reservoir."""
+        """p50/p99/p999 submit->delivery latency in us (reservoir)."""
         n = min(self._lat_n, LAT_RESERVOIR)
         if n == 0:
             return None
         w = self._lat[:n] * 1e6
         return {"n": self._lat_n,
                 "p50_us": float(np.percentile(w, 50)),
-                "p99_us": float(np.percentile(w, 99))}
+                "p99_us": float(np.percentile(w, 99)),
+                "p999_us": float(np.percentile(w, 99.9))}
 
     def snapshot(self) -> dict:
         d = {k: getattr(self, k) for k in (
