@@ -155,6 +155,20 @@ def _slow7(snap, hints):
     return _real7(snap, hints)
 _m7.dispatch_snap = _slow7
 _svc7._ewma["device"] = 50_000.0  # measured-over-budget device
+# calibrate the pass bound against THIS host's measured per-lookup cost
+# (the raw index_snap the inline path rides): an absolute 1000us bound
+# flakes on slow/contended hosts while hiding regressions on fast ones.
+# 50x raw-lookup p50 covers the service layer (locks, stats, histogram);
+# the 500us floor covers timer granularity on very fast hosts.
+_snap7 = _m7.snapshot()
+_cal7 = []
+for _i in range(200):
+    _t0 = time.perf_counter()
+    _m7.index_snap(_snap7, _Hint.of_host(f"svc{_i}.accept.example"))
+    _cal7.append(time.perf_counter() - _t0)
+_cal7.sort()
+_base7_us = _cal7[100] * 1e6
+_bound7_us = max(500.0, 50.0 * _base7_us)
 _lat7 = []
 for _i in range(200):
     _fired = []
@@ -166,7 +180,7 @@ for _i in range(200):
     _lat7.append(_dt * 1e6)
 _lat7.sort()
 _p50, _p99 = _lat7[100], _lat7[198]
-assert _p99 < 1000, (_p50, _p99)  # way under the 5000us budget on any host
+assert _p99 < _bound7_us, (_p50, _p99, _base7_us, _bound7_us)
 print(f"[7] accept-path inline classify @20k rules: p50 {_p50:.1f}us "
       f"p99 {_p99:.1f}us over 200 lone queries, "
       f"{_svc7.stats.oracle_queries} host-indexed, "
@@ -222,6 +236,9 @@ _sw8.stop(); _l8.close(); _h8.close()
 
 from vproxy_tpu.control.app import Application as _App8
 from vproxy_tpu.control.command import Command as _C8
+import os as _os8, sys as _sys8
+_sys8.path.insert(0, _os8.path.join(
+    _os8.path.dirname(_os8.path.abspath(__file__)), "tests"))
 from tests.test_dns import dns_query as _dq8
 from vproxy_tpu.dns import packet as _DP8
 _app8 = _App8.create(workers=1)

@@ -611,6 +611,14 @@ def child():
     # ---- ClassifyService accept->verdict under synthetic load
     if dl.remaining() > 40:
         result.update(service_section(ph, dl))
+        # /metrics snapshot: the vproxy_classify_latency_us histogram
+        # (the service_* percentiles above are sourced FROM it — same
+        # series a production scrape sees) plus the classify queue
+        # gauges, so the latency contract lives in the artifact
+        from vproxy_tpu.utils.metrics import GlobalInspection
+        result["classify_metrics"] = {
+            k: v for k, v in GlobalInspection.get().bench_snapshot().items()
+            if k.startswith(("vproxy_classify_",))}
         flush()
 
     result["partial"] = False

@@ -45,7 +45,7 @@ def build_tool():
     if (os.path.exists(BIN)
             and os.path.getmtime(BIN) >= os.path.getmtime(src)):
         return
-    subprocess.check_call(["g++", "-O2", "-o", BIN, src])
+    subprocess.check_call(["g++", "-O2", "-o", BIN, src, "-ldl"])
 
 
 def start_server():
@@ -212,6 +212,23 @@ def main():
             result.get("host_tcp_rps", 0) / 173000.0, 3)
         result["host_http_vs_ref_112k"] = round(
             result.get("host_http_rps", 0) / 112000.0, 3)
+
+        # /metrics snapshot: the accept-path span histograms
+        # (vproxy_accept_stage_us{stage=...}), the classify latency
+        # histogram, and the native pump counters accumulated over the
+        # load above — the latency contract IN the artifact, sourced
+        # from the same surface production scrapes
+        from vproxy_tpu.utils.metrics import GlobalInspection
+        snap = GlobalInspection.get().bench_snapshot()
+        result["host_metrics"] = {
+            k: v for k, v in snap.items()
+            if k.startswith(("vproxy_accept_stage_us",
+                             "vproxy_classify_latency_us",
+                             "vproxy_pump_", "vproxy_loop_"))}
+        acc = snap.get("vproxy_accept_stage_us.total")
+        if isinstance(acc, dict):
+            for q in ("p50", "p99", "p999"):
+                result[f"host_accept_{q}_us"] = acc.get(q)
         flush()
     finally:
         if lb is not None:

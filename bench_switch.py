@@ -322,6 +322,23 @@ def main():
             result.update(sock)
             flush()
 
+        # /metrics snapshot: the per-reason drop/forward counters the
+        # data plane incremented over everything above — the 68%-drop
+        # mystery as labeled numbers in the artifact. drop_rate =
+        # drops / rx over the whole stage (replay + socket pipeline).
+        from vproxy_tpu.utils.metrics import GlobalInspection
+        snap = GlobalInspection.get().bench_snapshot()
+        sw_counts = {k: v for k, v in snap.items()
+                     if k.startswith("vproxy_switch_")}
+        result["switch_metrics"] = sw_counts
+        rx = sw_counts.get("vproxy_switch_rx_total", 0)
+        drops = sum(v for k, v in sw_counts.items()
+                    if k.startswith("vproxy_switch_drops_total."))
+        result["switch_drops_total"] = drops
+        if rx:
+            result["switch_drop_rate"] = round(drops / rx, 4)
+        flush()
+
         # reference-style per-packet linear scan for context
         loop2, sw2, counter2, dgrams2 = build_world(backend="host")
         loops.append((loop2, sw2))

@@ -219,6 +219,88 @@ def test_fastpath_vni_override_parity():
     assert len(res[0]) > 0
 
 
+def _switch_counters():
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    return {k: v for k, v in GlobalInspection.get().bench_snapshot().items()
+            if k.startswith("vproxy_switch_")}
+
+
+def _delta(before, after):
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v - before.get(k, 0)}
+
+
+def test_fastpath_drop_reason_counters():
+    """The per-reason drop/forward accounting: route misses, ACL denies
+    and rx/forward totals land in vproxy_switch_* counters (swmetrics),
+    so the drop rate is computable from /metrics alone."""
+    burst = mk_burst(200)
+    n_miss = sum(1 for i in range(200) if i % 8 == 7)  # mk_burst kind 7
+    before = _switch_counters()
+    loop, sw, n1, n2, out, l2out = mk_world(True)
+    try:
+        loop.call_sync(lambda: sw._input_batch(list(burst)), timeout=120)
+        time.sleep(0.05)
+    finally:
+        sw.stop()
+        loop.close()
+    d = _delta(before, _switch_counters())
+    assert d.get("vproxy_switch_rx_total") == 200
+    assert d.get("vproxy_switch_drops_total.route_miss") == n_miss
+    assert d.get("vproxy_switch_forwards_total.fast", 0) > 0
+    assert "vproxy_switch_drops_total.acl_deny" not in d
+
+    # a deny-all ACL run consumes the bare rows as acl_deny
+    before = _switch_counters()
+    loop, sw, n1, n2, out, l2out = mk_world(True, default_allow=False)
+    try:
+        loop.call_sync(lambda: sw._input_batch(list(burst)), timeout=120)
+        time.sleep(0.05)
+        assert not out.frames
+    finally:
+        sw.stop()
+        loop.close()
+    d = _delta(before, _switch_counters())
+    assert d.get("vproxy_switch_drops_total.acl_deny", 0) > 0
+
+
+def test_fastpath_corrupt_checksum_parity():
+    """Frames whose INBOUND IPv4 header checksum is corrupt are demoted
+    to the object path (counted as slowpath{reason=bad_csum}) so both
+    pipelines stay bit-identical — the object path re-serializes with a
+    fresh checksum, and the fast path's incremental rewrite must not
+    silently 'fix' a corrupt header differently."""
+    gw1_mac = synthetic_mac(101, parse_ip("10.1.0.1"))
+    burst = []
+    for i in range(60):
+        src_mac = bytes([0x02, 0xaa, 0, 0, 0, 1 + i])
+        ip = P.Ipv4(src=bytes([10, 1, 0, 1 + i]),
+                    dst=bytes([10, 2, i % 40, 1 + i % 3]),
+                    proto=17, payload=b"c" * (8 + i % 4), ttl=64)
+        eth = P.Ethernet(gw1_mac, src_mac, 0x0800, b"", packet=ip)
+        raw = bytearray(P.Vxlan(101, eth).to_bytes())
+        if i % 3 == 0:  # corrupt every third frame's header checksum
+            raw[32] ^= 0x55  # vxlan(8)+eth(14)+ip csum hi byte (off 10)
+        burst.append((bytes(raw), f"127.0.0.{1 + i % 9}", 40000 + i))
+
+    before = _switch_counters()
+    res = []
+    for fastp in (True, False):
+        loop, sw, n1, n2, out, l2out = mk_world(fastp)
+        try:
+            loop.call_sync(lambda: sw._input_batch(list(burst)),
+                           timeout=120)
+            time.sleep(0.05)
+            res.append(_norm(out.frames))
+        finally:
+            sw.stop()
+            loop.close()
+    assert res[0] == res[1], "corrupt-checksum egress diverged"
+    assert len(res[0]) > 0
+    d = _delta(before, _switch_counters())
+    assert d.get("vproxy_switch_slowpath_total.bad_csum", 0) == 20
+
+
 def test_fastpath_incremental_checksum_exact():
     """RFC 1624 incremental update == full recompute for every ttl."""
     from vproxy_tpu.vswitch.fastpath import (_IP_CSUM, _IP_TTL)

@@ -3,12 +3,16 @@
 Reference analogs: prometheus/Metrics.java text exposition,
 GlobalInspection.java dumps, TestPrometheus.
 """
+import json
 import socket
+import threading
 import time
 
 from vproxy_tpu.net.eventloop import SelectorEventLoop
+from vproxy_tpu.utils.events import FlightRecorder
 from vproxy_tpu.utils.metrics import (Counter, Gauge, GaugeF, GlobalInspection,
-                                      MetricsRegistry, launch_inspection_http)
+                                      Histogram, MetricsRegistry,
+                                      launch_inspection_http)
 
 
 def http_get(port, path):
@@ -60,6 +64,228 @@ def test_global_inspection_http():
     finally:
         srv.close()
         loop.close()
+
+
+def test_histogram_buckets():
+    """log2 bucket placement: each observation lands in the smallest
+    bucket whose upper bound covers it; _bucket lines are cumulative."""
+    h = Histogram("lat_us", buckets=8)
+    for v, want in ((0.5, 1), (1.0, 1), (1.5, 2), (2.0, 2), (3.0, 4),
+                    (4.0, 4), (100.0, 128), (128.0, 128)):
+        before = dict(zip([1 << k for k in range(8)] + ["+Inf"],
+                          h._counts))
+        h.observe(v)
+        after = dict(zip([1 << k for k in range(8)] + ["+Inf"], h._counts))
+        assert after[want] == before[want] + 1, (v, want)
+    # past the last bound -> +Inf
+    h.observe(1e9)
+    assert h._counts[-1] == 1
+    assert h._count == 9
+
+
+def test_histogram_exposition():
+    r = MetricsRegistry()
+    h = r.histogram("vproxy_lat_us", buckets=4, stage="acl")
+    for v in (1, 2, 3, 100):
+        h.observe(v)
+    text = r.prometheus_text()
+    assert "# TYPE vproxy_lat_us histogram" in text
+    # cumulative: le=1 -> 1, le=2 -> 2, le=4 -> 3, le=8 -> 3, +Inf -> 4
+    assert 'vproxy_lat_us_bucket{le="1",stage="acl"} 1' in text
+    assert 'vproxy_lat_us_bucket{le="2",stage="acl"} 2' in text
+    assert 'vproxy_lat_us_bucket{le="4",stage="acl"} 3' in text
+    assert 'vproxy_lat_us_bucket{le="8",stage="acl"} 3' in text
+    assert 'vproxy_lat_us_bucket{le="+Inf",stage="acl"} 4' in text
+    assert 'vproxy_lat_us_sum{stage="acl"} 106' in text
+    assert 'vproxy_lat_us_count{stage="acl"} 4' in text
+
+
+def test_histogram_percentiles_reservoir_and_estimate():
+    # with a reservoir: exact over the window
+    h = Histogram("x_us", reservoir=1000)
+    for v in range(1, 1001):  # 1..1000
+        h.observe(float(v))
+    p = h.percentiles()
+    assert p["n"] == 1000
+    assert abs(p["p50"] - 500) <= 2
+    assert abs(p["p99"] - 990) <= 2
+    assert abs(p["p999"] - 999) <= 2
+    # without: log-linear estimate from the buckets, right magnitude
+    h2 = Histogram("y_us")
+    for v in range(1, 1001):
+        h2.observe(float(v))
+    p2 = h2.percentiles()
+    assert 256 <= p2["p50"] <= 1024
+    assert 512 <= p2["p99"] <= 1024
+
+
+def test_histogram_thread_safety_totals():
+    h = Histogram("t_us", reservoir=64)
+
+    def w():
+        for _ in range(1000):
+            h.observe(7.0)
+    ts = [threading.Thread(target=w) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert h._count == 4000
+    assert h._sum == 7.0 * 4000
+
+
+def test_flight_recorder_ring_and_events_endpoint():
+    FlightRecorder.reset()
+    try:
+        fr = FlightRecorder.get()
+        for i in range(5):
+            fr.record("conn", f"c{i} closed", bytes_in=i)
+        snap = fr.snapshot()
+        assert [e["msg"] for e in snap] == [f"c{i} closed" for i in range(5)]
+        assert [e["seq"] for e in snap] == [1, 2, 3, 4, 5]
+        assert snap[0]["bytes_in"] == 0
+        assert fr.lines(2) == fr.lines()[-2:]
+
+        loop = SelectorEventLoop("ev")
+        loop.loop_thread()
+        srv = launch_inspection_http(loop, "127.0.0.1", 0)
+        try:
+            st, body = http_get(srv.port, "/events")
+            assert st == 200
+            evs = json.loads(body)
+            assert len(evs) == 5 and evs[-1]["msg"] == "c4 closed"
+            st, body = http_get(srv.port, "/events?n=2")
+            assert [e["msg"] for e in json.loads(body)] == \
+                ["c3 closed", "c4 closed"]
+        finally:
+            srv.close()
+            loop.close()
+    finally:
+        FlightRecorder.reset()
+
+
+def test_flight_recorder_capacity_eviction():
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("k", str(i))
+    snap = fr.snapshot()
+    assert len(snap) == 4
+    assert [e["msg"] for e in snap] == ["6", "7", "8", "9"]
+    assert fr.dropped == 6
+
+
+def test_event_log_command():
+    FlightRecorder.reset()
+    try:
+        from vproxy_tpu.control.command import Command
+        FlightRecorder.get().record("hc_down", "g/s 1.2.3.4:80 DOWN",
+                                    group="g")
+        lines = Command.execute(None, "list event-log")
+        assert len(lines) == 1 and "hc_down" in lines[0]
+        detail = Command.execute(None, "list-detail event-log")
+        assert detail[0]["kind"] == "hc_down"
+        assert detail[0]["group"] == "g"
+    finally:
+        FlightRecorder.reset()
+
+
+def test_pump_counters_roundtrip():
+    """Bytes moved by the splice pump show up in vtl.pump_counters()
+    and on /metrics as vproxy_pump_bytes_total (native C atomics or the
+    py provider's tallies — whichever provider is loaded)."""
+    from vproxy_tpu.net import vtl
+    from vproxy_tpu.net.connection import Connection, Handler, ServerSock
+
+    before = vtl.pump_counters()
+    assert len(before) == 4
+
+    backend = socket.socket()
+    backend.bind(("127.0.0.1", 0))
+    backend.listen(8)
+    bport = backend.getsockname()[1]
+
+    def serve():
+        c, _ = backend.accept()
+        while True:
+            d = c.recv(65536)
+            if not d:
+                break
+            c.sendall(d)
+        c.close()
+    threading.Thread(target=serve, daemon=True).start()
+
+    loop = SelectorEventLoop("pumpc")
+    loop.loop_thread()
+    done = {}
+
+    def on_accept(cfd, ip, port):
+        back = Connection.connect(loop, "127.0.0.1", bport)
+
+        class Back(Handler):
+            def on_connected(self, conn):
+                bfd = conn.detach()
+                loop.pump(cfd, bfd, 65536, lambda a2b, b2a, err:
+                          done.setdefault("stat", (a2b, b2a, err)))
+
+            def on_closed(self, conn, err):
+                done.setdefault("stat", (0, 0, err or 1))
+        back.set_handler(Back())
+
+    holder = {}
+    loop.run_on_loop(lambda: holder.setdefault(
+        "srv", ServerSock(loop, "127.0.0.1", 0, on_accept)))
+    t0 = time.time()
+    while "srv" not in holder and time.time() - t0 < 5:
+        time.sleep(0.005)
+    try:
+        cli = socket.create_connection(
+            ("127.0.0.1", holder["srv"].port), timeout=5)
+        payload = b"z" * 200_000
+        threading.Thread(target=lambda: (cli.sendall(payload),
+                                         cli.shutdown(socket.SHUT_WR)),
+                         daemon=True).start()
+        rx = b""
+        while True:
+            d = cli.recv(65536)
+            if not d:
+                break
+            rx += d
+        cli.close()
+        assert rx == payload
+        t0 = time.time()
+        while "stat" not in done and time.time() - t0 < 5:
+            time.sleep(0.005)
+    finally:
+        loop.close()
+        backend.close()
+
+    after = vtl.pump_counters()
+    moved = after[0] - before[0]
+    assert moved >= 2 * len(payload), (before, after)  # both directions
+    assert after[1] > before[1]  # write calls
+    # and the /metrics surface exposes the same counter
+    text = GlobalInspection.get().registry.prometheus_text()
+    assert "vproxy_pump_bytes_total" in text
+    assert "vproxy_pump_splice_calls_total" in text
+
+
+def test_accept_stage_histograms_on_metrics():
+    from vproxy_tpu.utils.metrics import accept_stage_observe
+    accept_stage_observe("acl", 0.000050)
+    accept_stage_observe("total", 0.000200)
+    text = GlobalInspection.get().registry.prometheus_text()
+    assert 'vproxy_accept_stage_us_bucket{le="64",stage="acl"}' in text
+    assert 'vproxy_accept_stage_us_count{stage="total"} ' in text
+
+
+def test_bench_snapshot_shape():
+    gi = GlobalInspection.get()
+    h = gi.get_histogram("vproxy_snaptest_us", stage="x")
+    h.observe(10.0)
+    c = gi.get_counter("vproxy_snaptest_total", reason="r")
+    c.incr(3)
+    snap = gi.bench_snapshot()
+    assert snap["vproxy_snaptest_total.r"] == 3
+    assert snap["vproxy_snaptest_us.x"]["n"] == 1
+    assert "p99" in snap["vproxy_snaptest_us.x"]
 
 
 def test_loop_registration_lifecycle():

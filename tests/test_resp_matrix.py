@@ -242,6 +242,7 @@ def test_resp_matrix_covers_creatable_inventory():
         "arp", "conntrack", "config", "auto-lb", "resp-controller",
         "http-controller", "docker-network-plugin-controller", "tap",
         "xdp", "vlan-adaptor",
+        "event-log",  # list-only flight-recorder dump (utils/events)
     }
     for t in set(TYPES.values()):
         assert t in covered or t in uncreatable, \

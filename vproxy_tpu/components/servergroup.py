@@ -327,6 +327,11 @@ class ServerGroup:
         self._listeners.append(cb)
 
     def _notify(self, svr: ServerHandle, up: bool) -> None:
+        from ..utils import events
+        events.record("hc_up" if up else "hc_down",
+                      f"{self.alias}/{svr.name} {svr.ip}:{svr.port} "
+                      + ("UP" if up else "DOWN"),
+                      group=self.alias, server=svr.name)
         for cb in self._listeners:
             cb(svr, up)
 

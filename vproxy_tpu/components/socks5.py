@@ -46,7 +46,8 @@ class Socks5Server(TcpLB):
         self.allow_non_backend = allow_non_backend
 
     # override: every accepted conn goes through the handshake
-    def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _serve(self, loop, cfd: int, ip: str, port: int,
+               t_acc=None) -> None:
         _Socks5Session(self, loop, cfd, ip, port)
 
     # ---------------------------------------------------------- selection

@@ -15,6 +15,7 @@ full per-request/per-stream L7 engine (components/l7.py).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from ..net import vtl
@@ -22,8 +23,10 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
+from ..utils import events
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
+from ..utils.metrics import accept_stage_observe
 from .elgroup import EventLoopGroup
 from .l7 import L7Engine
 from .secgroup import SecurityGroup
@@ -39,10 +42,11 @@ class _SpliceBack(Handler):
     short-connection profile)."""
 
     __slots__ = ("lb", "loop", "front_fd", "target", "head", "front",
-                 "_pid", "tls_ctx")
+                 "_pid", "tls_ctx", "t_acc", "t_back")
 
     def __init__(self, lb, loop, front_fd: int, target: Connector,
-                 head: bytes, front: str, tls_ctx: int = 0):
+                 head: bytes, front: str, tls_ctx: int = 0,
+                 t_acc: Optional[float] = None):
         self.lb = lb
         self.loop = loop
         self.front_fd = front_fd
@@ -51,6 +55,8 @@ class _SpliceBack(Handler):
         self.front = front
         self._pid = None
         self.tls_ctx = tls_ctx  # nonzero: TLS-terminating pump
+        self.t_acc = t_acc         # accept timestamp (span timers)
+        self.t_back = time.monotonic()  # backend chosen -> handover span
 
     def on_connected(self, conn: Connection) -> None:
         # do NOT consume early backend bytes (100-continue, early
@@ -79,9 +85,16 @@ class _SpliceBack(Handler):
             pid = self.loop.pump(self.front_fd, bfd,
                                  self.lb.in_buffer_size, self._done)
         self._pid = pid
+        now = time.monotonic()
         self.lb._watch_pump(
             self.loop, pid,
             f"{self.front} -> {self.target.ip}:{self.target.port}")
+        # span observations AFTER the watch registration: the native pump
+        # moves bytes without the GIL, so a session-listing racing these
+        # (lock-taking) calls must already see the pump as spliced
+        accept_stage_observe("handover", now - self.t_back)
+        if self.t_acc is not None:
+            accept_stage_observe("total", now - self.t_acc)
 
     def _done(self, a2b: int, b2a: int, err: int) -> None:
         lb, svr = self.lb, self.target.svr
@@ -92,11 +105,17 @@ class _SpliceBack(Handler):
         svr.bytes_out += b2a
         svr.conn_count -= 1
         lb.active_sessions -= 1
+        events.record(
+            "conn", f"{self.front} -> {self.target.ip}:{self.target.port} "
+            "closed", lb=lb.alias, bytes_in=a2b, bytes_out=b2a, err=err)
 
     def on_closed(self, conn: Connection, err: int) -> None:
         self.target.svr.conn_count -= 1
         self.lb.active_sessions -= 1
         vtl.close(self.front_fd)
+        events.record(
+            "conn", f"{self.front} -> {self.target.ip}:{self.target.port} "
+            "backend connect failed", lb=self.lb.alias, err=err)
 
 
 class TcpLB:
@@ -210,20 +229,27 @@ class TcpLB:
 
     def _on_accept(self, loop, cfd: int, ip: str, port: int) -> None:
         self.accepted += 1
+        t_acc = time.monotonic()
 
         # ACL gate (SecurityGroup.allow — TcpLB.java:168-171); the lookup
         # rides the ClassifyService micro-batch queue, coalescing with
         # other in-flight accepts across connections/loops
         def on_verdict(ok: bool) -> None:
+            accept_stage_observe("acl", time.monotonic() - t_acc)
             if not ok or not self.started:
+                if not ok:
+                    events.record("conn_denied",
+                                  f"{ip}:{port} denied by ACL",
+                                  lb=self.alias)
                 vtl.close(cfd)
                 return
             if self.worker is not self.acceptor:
                 wl = self.worker.next()
-                if not wl.run_on_loop(lambda: self._serve(wl, cfd, ip, port)):
+                if not wl.run_on_loop(
+                        lambda: self._serve(wl, cfd, ip, port, t_acc)):
                     vtl.close(cfd)  # worker loop died; don't leak the fd
             else:
-                self._serve(loop, cfd, ip, port)
+                self._serve(loop, cfd, ip, port, t_acc)
 
         try:
             self.security_group.allow_async(Proto.TCP, parse_ip(ip),
@@ -232,21 +258,25 @@ class TcpLB:
             vtl.close(cfd)  # classify queue unavailable: refuse, not leak
             raise
 
-    def _serve(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _serve(self, loop, cfd: int, ip: str, port: int,
+               t_acc: Optional[float] = None) -> None:
         """Owns cfd: every branch either hands it off or closes it exactly
         once — including when `loop` died while the accept's ACL verdict
         was in flight (the verdict then runs on the dispatcher thread, or
         via the closed loop's promised-task drain)."""
         if self.holder is not None:
-            self._serve_tls(loop, cfd, ip, port)
+            self._serve_tls(loop, cfd, ip, port, t_acc)
         elif self.protocol == "tcp":
+            t0 = time.monotonic()
             conn = self.backend.next(parse_ip(ip))
+            accept_stage_observe("backend_pick", time.monotonic() - t0)
             if conn is None:
                 vtl.close(cfd)
                 return
-            self._splice(loop, cfd, conn, b"", front=f"{ip}:{port}")
+            self._splice(loop, cfd, conn, b"", front=f"{ip}:{port}",
+                         t_acc=t_acc)
         elif self.protocol == "http-splice":
-            self._http_classify(loop, cfd, ip, port)
+            self._http_classify(loop, cfd, ip, port, t_acc)
         else:
             try:
                 L7Engine(self, loop, cfd, ip, port,
@@ -254,7 +284,8 @@ class TcpLB:
             except Exception:
                 pass  # L7Engine closes cfd on its failure paths
 
-    def _serve_tls(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _serve_tls(self, loop, cfd: int, ip: str, port: int,
+                   t_acc: Optional[float] = None) -> None:
         """TLS termination. protocol=tcp on the native provider takes
         the C-side path: MSG_PEEK the ClientHello for SNI (cert choice +
         classify hint), then hand the untouched socket to the OpenSSL
@@ -267,7 +298,7 @@ class TcpLB:
         if (self.protocol == "tcp" and vtl.PROVIDER == "native"
                 and _os.environ.get("VPROXY_TPU_NATIVE_TLS", "1") != "0"
                 and vtl.tls_available() and not self._mirror_wants_tls()):
-            self._serve_tls_native(loop, cfd, ip, port)
+            self._serve_tls_native(loop, cfd, ip, port, t_acc)
             return
         from ..net.tls import TlsSocket
         from ..processors.base import TcpRelaySession
@@ -295,15 +326,22 @@ class TcpLB:
         m = Mirror.get()
         return m.hot and m.wants("ssl")  # net/tls.py's mirror origin
 
-    def _serve_tls_native(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _serve_tls_native(self, loop, cfd: int, ip: str, port: int,
+                          t_acc: Optional[float] = None) -> None:
         """Peek the ClientHello (bytes stay queued), choose the cert and
         classify by SNI, connect the backend, then run the C-side
         TLS-terminating splice pump on the untouched client socket."""
         from ..net.sniff import MAX_HELLO, parse_client_hello_sni
         from ..rules.ir import Hint
         lb = self
-        deadline = [loop.delay(self.timeout_ms, lambda: self._peek_abort(
-            loop, cfd))]
+        # the timeout abort gets the deadline list so it clears
+        # deadline[0]: the parked-hello rearm timer guards on that, and
+        # without it a post-timeout rearm could re-enable reads on a
+        # RECYCLED fd number owned by an unrelated connection
+        deadline: list = [None]
+        deadline[0] = loop.delay(
+            self.timeout_ms,
+            lambda: self._peek_abort(loop, cfd, deadline))
 
         def on_ev(fd: int, ev: int) -> None:
             if ev & vtl.EV_ERROR:
@@ -357,7 +395,7 @@ class TcpLB:
                     vtl.close(cfd)
                     return
                 self._splice_tls(loop, cfd, back, ctx,
-                                 front=f"{ip}:{port}")
+                                 front=f"{ip}:{port}", t_acc=t_acc)
 
             lb.backend.next_async(parse_ip(ip), hint, on_back, loop=loop)
 
@@ -400,7 +438,8 @@ class TcpLB:
         L7Engine(self, loop, cfd, ip, port, factory, front=tls)
 
     def _splice_tls(self, loop, front_fd: int, target: Connector,
-                    ctx: int, front: str = "?") -> None:
+                    ctx: int, front: str = "?",
+                    t_acc: Optional[float] = None) -> None:
         """Like _splice, but the handover runs the TLS-terminating pump
         (client side TLS in C, backend plaintext)."""
         svr = target.svr
@@ -414,7 +453,8 @@ class TcpLB:
             vtl.close(front_fd)
             return
         back.set_handler(_SpliceBack(self, loop, front_fd, target, b"",
-                                     f"tls {front}", tls_ctx=ctx))
+                                     f"tls {front}", tls_ctx=ctx,
+                                     t_acc=t_acc))
 
     # ------------------------------------------------------ idle timeout
 
@@ -492,7 +532,8 @@ class TcpLB:
             self._sweep_timers[id(loop)] = loop.delay(
                 max(self.timeout_ms // 4, 1000), sweep)
 
-    def _http_classify(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _http_classify(self, loop, cfd: int, ip: str, port: int,
+                       t_acc: Optional[float] = None) -> None:
         lb = self
         parser = HeadParser()
         try:
@@ -528,7 +569,7 @@ class TcpLB:
                         buffered = bytes(parser.buf)
                         ffd = conn.detach()
                         lb._splice(loop, ffd, back, buffered,
-                                   front=f"{ip}:{port}")
+                                   front=f"{ip}:{port}", t_acc=t_acc)
 
                     lb.backend.next_async(parse_ip(ip), hint, on_back,
                                           loop=loop)
@@ -539,7 +580,8 @@ class TcpLB:
         front.set_handler(Front())
 
     def _splice(self, loop, front_fd: int, target: Connector,
-                head: bytes, front: str = "?") -> None:
+                head: bytes, front: str = "?",
+                t_acc: Optional[float] = None) -> None:
         svr = target.svr
         svr.conn_count += 1
         self.active_sessions += 1
@@ -551,4 +593,4 @@ class TcpLB:
             vtl.close(front_fd)
             return
         back.set_handler(_SpliceBack(self, loop, front_fd, target, head,
-                                     front))
+                                     front, t_acc=t_acc))

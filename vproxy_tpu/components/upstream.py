@@ -10,10 +10,12 @@ and single queries or micro-batches go through the same compiled kernel.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 from ..rules.engine import HintMatcher
 from ..rules.ir import Hint, HintRule
+from ..utils.metrics import accept_stage_observe
 from .servergroup import Connector, ServerGroup
 
 
@@ -150,19 +152,33 @@ class Upstream:
         device dispatch; cb(Connector | None) fires on *loop*.
 
         This is the replacement for the reference's per-connection scan
-        in Upstream.searchForGroup (Upstream.java:187-198)."""
+        in Upstream.searchForGroup (Upstream.java:187-198).
+
+        Span timers: the hint classify (submit->index) lands in the
+        `classify` accept-stage histogram, the group/WRR selection in
+        `backend_pick` (utils/metrics accept_stage_observe)."""
         if hint is None or not self.handles:
-            cb(self._wrr_next(source_ip, fam))
+            t0 = time.monotonic()
+            c = self._wrr_next(source_ip, fam)
+            accept_stage_observe("backend_pick", time.monotonic() - t0)
+            cb(c)
             return
         from ..rules.service import ClassifyService
+        t_sub = time.monotonic()
 
         def on_idx(idx: int, handles) -> None:
+            t_idx = time.monotonic()
+            accept_stage_observe("classify", t_idx - t_sub)
             if handles and 0 <= idx < len(handles):
                 c = handles[idx].group.next(source_ip, fam)
                 if c is not None:
+                    accept_stage_observe("backend_pick",
+                                         time.monotonic() - t_idx)
                     cb(c)
                     return
-            cb(self._wrr_next(source_ip, fam))
+            c = self._wrr_next(source_ip, fam)
+            accept_stage_observe("backend_pick", time.monotonic() - t_idx)
+            cb(c)
 
         ClassifyService.get().submit_hint(self._matcher, hint, on_idx, loop)
 

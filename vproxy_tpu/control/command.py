@@ -67,6 +67,7 @@ TYPES = {
     "resp-controller": "resp-controller",
     "http-controller": "http-controller",
     "docker-network-plugin-controller": "docker-network-plugin-controller",
+    "event-log": "event-log", "events": "event-log",
 }
 
 PARAM_KEYS = {
@@ -1102,6 +1103,19 @@ def _h_stats(app: Application, c: Command):
     raise CmdError(f"unsupported stat {c.type}")
 
 
+def _h_eventlog(app: Application, c: Command):
+    """`list event-log` — the flight-recorder ring (utils/events):
+    connection lifecycle, loop stalls, classify failovers, health-check
+    edges. list-detail returns the raw event dicts (what /events
+    serves); list returns human-form lines."""
+    from ..utils.events import FlightRecorder
+    if c.action == "list":
+        return FlightRecorder.get().lines()
+    if c.action == "list-detail":
+        return FlightRecorder.get().snapshot()
+    raise CmdError(f"unsupported action {c.action} for event-log")
+
+
 def _h_resolver(app: Application, c: Command):
     """The reference's resolver is a singleton named "(default)"
     (ResolverHandle.java:10-16); dns-cache lives inside it."""
@@ -1255,6 +1269,7 @@ def _h_docker(app: Application, c: Command):
 
 
 _HANDLERS = {
+    "event-log": _h_eventlog,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,
     "proxy": _h_proxy,

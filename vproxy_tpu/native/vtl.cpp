@@ -27,6 +27,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <unordered_map>
 #include <unordered_set>
 #include <algorithm>
@@ -573,6 +574,32 @@ int vtl_recv_peek(int fd, void* buf, int len) {
 
 // ------------------------------------------------------------ pump engine
 
+// Process-global pump counters (all loops/threads): total payload bytes
+// moved, write syscalls issued, writes that moved fewer bytes than
+// requested (incl. EAGAIN — the backpressure signal), and completed TLS
+// handshakes. Exposed to Python through vtl_pump_counters() and
+// surfaced on /metrics as vproxy_pump_*_total.
+static std::atomic<uint64_t> g_pump_bytes(0), g_pump_writes(0),
+    g_pump_short_writes(0), g_tls_handshakes(0);
+
+static inline void count_write(ssize_t wrote, size_t wanted) {
+  g_pump_writes.fetch_add(1, std::memory_order_relaxed);
+  if (wrote > 0)
+    g_pump_bytes.fetch_add((uint64_t)wrote, std::memory_order_relaxed);
+  if (wrote < (ssize_t)wanted)
+    g_pump_short_writes.fetch_add(1, std::memory_order_relaxed);
+}
+
+// out[0]=bytes, out[1]=write calls, out[2]=short writes, out[3]=tls
+// handshakes; returns 4 (the counter count)
+int vtl_pump_counters(uint64_t* out) {
+  out[0] = g_pump_bytes.load(std::memory_order_relaxed);
+  out[1] = g_pump_writes.load(std::memory_order_relaxed);
+  out[2] = g_pump_short_writes.load(std::memory_order_relaxed);
+  out[3] = g_tls_handshakes.load(std::memory_order_relaxed);
+  return 4;
+}
+
 static void pump_update_interest(Loop* l, Pump* p);
 
 static void pump_kill(Loop* l, Pump* p, int err) {
@@ -602,6 +629,7 @@ static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
   while (!ring.empty()) {
     size_t chunk = std::min(ring.size, ring.cap() - ring.head);
     ssize_t n = write(dst, ring.buf.data() + ring.head, chunk);
+    count_write(n, chunk);
     if (n > 0) {
       ring.head = (ring.head + (size_t)n) % ring.cap();
       ring.size -= (size_t)n;
@@ -624,6 +652,7 @@ static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
       while (!ring.empty()) {
         size_t c2 = std::min(ring.size, ring.cap() - ring.head);
         ssize_t w = write(dst, ring.buf.data() + ring.head, c2);
+        count_write(w, c2);
         if (w > 0) {
           ring.head = (ring.head + (size_t)w) % ring.cap();
           ring.size -= (size_t)w;
@@ -691,6 +720,7 @@ static void tls_pump_run(Loop* l, Pump* p) {
     int r = TLSA.SSL_do_handshake(ssl);
     if (r == 1) {
       p->handshaking = false;
+      g_tls_handshakes.fetch_add(1, std::memory_order_relaxed);
     } else {
       bool dummy = false;
       if (tls_err(l, p, r, nullptr, &dummy, &p->hs_want_write) < 0) return;
@@ -703,6 +733,7 @@ static void tls_pump_run(Loop* l, Pump* p) {
   while (!ab.empty()) {
     size_t chunk = std::min(ab.size, ab.cap() - ab.head);
     ssize_t n = write(p->fd_b, ab.buf.data() + ab.head, chunk);
+    count_write(n, chunk);
     if (n > 0) {
       ab.head = (ab.head + (size_t)n) % ab.cap();
       ab.size -= (size_t)n;
@@ -724,6 +755,7 @@ static void tls_pump_run(Loop* l, Pump* p) {
       while (!ab.empty()) {
         size_t c2 = std::min(ab.size, ab.cap() - ab.head);
         ssize_t w = write(p->fd_b, ab.buf.data() + ab.head, c2);
+        count_write(w, c2);
         if (w > 0) {
           ab.head = (ab.head + (size_t)w) % ab.cap();
           ab.size -= (size_t)w;
@@ -751,6 +783,7 @@ static void tls_pump_run(Loop* l, Pump* p) {
   while (!ba.empty() && !p->wr_want_read && !p->wr_want_write) {
     size_t chunk = std::min(ba.size, ba.cap() - ba.head);
     int n = TLSA.SSL_write(ssl, ba.buf.data() + ba.head, (int)chunk);
+    count_write(n, chunk);
     if (n > 0) {
       ba.head = (ba.head + (size_t)n) % ba.cap();
       ba.size -= (size_t)n;
@@ -772,6 +805,7 @@ static void tls_pump_run(Loop* l, Pump* p) {
       while (!ba.empty() && !p->wr_want_read && !p->wr_want_write) {
         size_t c2 = std::min(ba.size, ba.cap() - ba.head);
         int w = TLSA.SSL_write(ssl, ba.buf.data() + ba.head, (int)c2);
+        count_write(w, c2);
         if (w > 0) {
           ba.head = (ba.head + (size_t)w) % ba.cap();
           ba.size -= (size_t)w;

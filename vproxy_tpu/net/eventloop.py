@@ -17,6 +17,7 @@ from __future__ import annotations
 import ctypes
 import heapq
 import itertools
+import os
 import threading
 import time
 import traceback
@@ -26,6 +27,11 @@ from typing import Callable, Optional
 from . import vtl
 
 MAX_EVENTS = 256
+
+# a single callback holding the loop thread past this is a stall: the
+# known GIL-contention p999 culprit — recorded to the flight recorder
+# (utils/events) and surfaced via vproxy_loop_callback_us_max
+STALL_MS = float(os.environ.get("VPROXY_TPU_LOOP_STALL_MS", "100"))
 
 
 def _guard(fn, *args) -> None:
@@ -111,6 +117,36 @@ class SelectorEventLoop:
         self.now = time.monotonic()
         self._tags_buf = (ctypes.c_uint64 * MAX_EVENTS)()
         self._evs_buf = (ctypes.c_uint32 * MAX_EVENTS)()
+        # loop-health windows (seconds), reset when /metrics scrapes them
+        # through take_health(): worst timer slip (fire time past the
+        # deadline) and longest single callback since the last read
+        self._health = {"slip": 0.0, "cb": 0.0}
+        self._stall_s = STALL_MS / 1000.0
+
+    def take_health(self, key: str) -> float:
+        """Read-and-reset one health window (racy by design: a lost
+        concurrent max only shortens one scrape interval's evidence)."""
+        v = self._health[key]
+        self._health[key] = 0.0
+        return v
+
+    def _timed(self, fn, *args) -> None:
+        """_guard plus callback-duration accounting + stall events."""
+        t0 = time.monotonic()
+        try:
+            _guard(fn, *args)
+        finally:
+            dt = time.monotonic() - t0
+            if dt > self._health["cb"]:
+                self._health["cb"] = dt
+            if dt > self._stall_s:
+                from ..utils import events
+                events.record(
+                    "loop_stall",
+                    f"loop {self.name}: callback held the thread "
+                    f"{dt * 1e3:.1f}ms",
+                    loop=self.name, ms=round(dt * 1e3, 1),
+                    fn=getattr(fn, "__qualname__", repr(fn)))
 
     # ------------------------------------------------------------ registry
 
@@ -244,9 +280,9 @@ class SelectorEventLoop:
             with self._xq_lock:
                 items, self._xq = self._xq, deque()
             for fn in items:
-                _guard(fn)
+                self._timed(fn)
         while self._tick_q:
-            _guard(self._tick_q.popleft())
+            self._timed(self._tick_q.popleft())
 
     def _run_timers(self) -> None:
         now = time.monotonic()
@@ -254,7 +290,10 @@ class SelectorEventLoop:
         while self._timers and self._timers[0].deadline <= now:
             t = heapq.heappop(self._timers)
             if not t.cancelled:
-                _guard(t.fn)
+                slip = now - t.deadline
+                if slip > self._health["slip"]:
+                    self._health["slip"] = slip
+                self._timed(t.fn)
 
     def _next_timeout_ms(self) -> int:
         while self._timers and self._timers[0].cancelled:
@@ -281,12 +320,12 @@ class SelectorEventLoop:
                 a2b, b2a, err = self.pump_stat(tag)
                 vtl.LIB.vtl_pump_free(self._lp, tag)
                 if cb is not None:
-                    _guard(cb, a2b, b2a, err)
+                    self._timed(cb, a2b, b2a, err)
                 continue
             ent = self._handlers.get(tag)
             if ent is not None:
                 fd, cb = ent
-                _guard(cb, fd, ev)
+                self._timed(cb, fd, ev)
         self._run_queues()
         self._run_timers()
 

@@ -74,6 +74,10 @@ def _load() -> ctypes.CDLL:
     lib.vtl_pump_stat.argtypes = [p, u64, ctypes.POINTER(u64)]
     lib.vtl_pump_close.argtypes = [p, u64]
     lib.vtl_pump_free.argtypes = [p, u64]
+    try:  # absent from a prebuilt pre-counters .so: pump_counters()
+        lib.vtl_pump_counters.argtypes = [ctypes.POINTER(u64)]
+    except AttributeError:  # then reports zeros, everything else works
+        pass
     i64 = ctypes.c_longlong
     lib.vtl_tls_init.argtypes = []
     lib.vtl_tls_ctx_new.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
@@ -228,6 +232,25 @@ if LIB is None:
     for _n in _py.EXPORTS:
         if _n != "LIB":
             globals()[_n] = getattr(_py, _n)
+
+
+# -------------------------------------------------------- pump counters
+
+def pump_counters() -> tuple:
+    """Process-global splice-pump counters: (bytes_spliced, write_calls,
+    short_writes, tls_handshakes). Native provider reads the C atomics
+    (vtl_pump_counters); the py provider keeps its own tallies; an old
+    .so without the symbol reports zeros."""
+    if PROVIDER == "py":
+        from . import vtl_py as _p
+        return tuple(_p.PUMP_COUNTERS)
+    try:
+        fn = LIB.vtl_pump_counters
+    except AttributeError:
+        return (0, 0, 0, 0)
+    out = (ctypes.c_uint64 * 4)()
+    fn(out)
+    return tuple(int(x) for x in out)
 
 
 # --------------------------------------------------------------- fdtrace

@@ -33,6 +33,11 @@ AGAIN = -errno.EAGAIN
 # accept/sendto/recvfrom/getsockname use the object API on the raw fd)
 _socks: dict[int, socket.socket] = {}
 
+# [bytes_spliced, write_calls, short_writes, tls_handshakes] — parity
+# with the native provider's vtl_pump_counters (vtl.pump_counters());
+# the py provider has no TLS pump so [3] stays 0
+PUMP_COUNTERS = [0, 0, 0, 0]
+
 _BLOCKING_IO = (BlockingIOError,)
 
 
@@ -364,15 +369,22 @@ class _PyLoop:
                ctr_attr: str) -> bool:
         """ring -> dst until EAGAIN/empty. False = pump killed."""
         while ring:
+            want = min(len(ring), 262144)
             try:
                 n = os.write(dst, memoryview(ring)[:262144])
             except _BLOCKING_IO:
+                PUMP_COUNTERS[1] += 1
+                PUMP_COUNTERS[2] += 1
                 return True
             except OSError as e:
                 self._pump_kill(p, e.errno or errno.EPIPE)
                 return False
+            PUMP_COUNTERS[1] += 1
+            if n < want:
+                PUMP_COUNTERS[2] += 1
             if n <= 0:
                 return True
+            PUMP_COUNTERS[0] += n
             del ring[:n]
             setattr(p, ctr_attr, getattr(p, ctr_attr) + n)
         return True

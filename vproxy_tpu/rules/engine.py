@@ -256,7 +256,11 @@ class HintMatcher:
         if self.backend == "jax-fp":
             from ..ops import fphash as F
             q = F.encode_hint_queries_fp(hints, tab)
-            idx, _ = F.hint_fp_jit(dev, q)
+            # resolve the member-mode env knob HERE, per dispatch: jit
+            # keys on the static mode arg, so passing None would bake
+            # the first dispatch's VPROXY_TPU_FP_MEMBER into the cache
+            # and silently ignore later changes (stale lowering)
+            idx, _ = F.hint_fp_jit(dev, q, mode=F.default_member_mode())
             return idx
         if self.backend in ("jax-sharded", "jax-fp-sharded"):
             from ..parallel import mesh as M

@@ -101,32 +101,41 @@ class ClassifyStats:
         self.failovers = 0        # device errors that degraded a batch
         self.max_batch = 0
         self.budget_reroutes = 0  # lone queries sent to oracle by budget
-        # submit->delivery latency reservoir. Writers are the dispatcher
-        # thread AND every inline-answering submit thread, so all
-        # read-modify-writes go through `lock` (bump/record_latency)
+        # counter read-modify-writes go through `lock` (writers are the
+        # dispatcher thread AND every inline-answering submit thread)
         self.lock = threading.Lock()
-        self._lat = np.zeros(LAT_RESERVOIR, np.float64)
-        self._lat_n = 0
+        # submit->delivery latency rides the process-global histogram
+        # (utils/metrics): log2 buckets on /metrics as
+        # vproxy_classify_latency_us_{bucket,sum,count}. That series
+        # survives ClassifyService.reset() — it is per-process, like the
+        # /metrics surface it feeds. A second, UNregistered histogram
+        # keeps this instance's own exact reservoir window, so the
+        # p99-contract percentiles of a fresh service (bench runs one
+        # per contract) are not polluted by a previous instance's
+        # samples still sitting in a shared ring.
+        from ..utils.metrics import GlobalInspection, Histogram
+        self.lat_hist = GlobalInspection.get().get_histogram(
+            "vproxy_classify_latency_us", reservoir=LAT_RESERVOIR)
+        self._lat_local = Histogram("classify_latency_local_us",
+                                    reservoir=LAT_RESERVOIR)
 
     def bump(self, name: str, n: int = 1) -> None:
         with self.lock:
             setattr(self, name, getattr(self, name) + n)
 
     def record_latency(self, seconds: float) -> None:
-        with self.lock:
-            self._lat[self._lat_n % LAT_RESERVOIR] = seconds
-            self._lat_n += 1
+        us = seconds * 1e6
+        self.lat_hist.observe(us)
+        self._lat_local.observe(us)
 
     def latency_percentiles(self) -> Optional[dict]:
-        """p50/p99/p999 submit->delivery latency in us (reservoir)."""
-        n = min(self._lat_n, LAT_RESERVOIR)
-        if n == 0:
+        """p50/p99/p999 submit->delivery latency in us (exact over this
+        instance's reservoir window)."""
+        pct = self._lat_local.percentiles((50.0, 99.0, 99.9))
+        if pct is None:
             return None
-        w = self._lat[:n] * 1e6
-        return {"n": self._lat_n,
-                "p50_us": float(np.percentile(w, 50)),
-                "p99_us": float(np.percentile(w, 99)),
-                "p999_us": float(np.percentile(w, 99.9))}
+        return {"n": pct["n"], "p50_us": pct["p50"],
+                "p99_us": pct["p99"], "p999_us": pct["p999"]}
 
     def snapshot(self) -> dict:
         d = {k: getattr(self, k) for k in (
@@ -270,8 +279,7 @@ class ClassifyService:
         with st.lock:
             st.oracle_queries += 1
             st.max_batch = max(st.max_batch, 1)
-            st._lat[st._lat_n % LAT_RESERVOIR] = dt
-            st._lat_n += 1
+        st.record_latency(dt)
         if big:
             self._note_lone_latency("oracle", dt)
             with self._elock:
@@ -333,6 +341,10 @@ class ClassifyService:
                 self._device_down_until = time.monotonic() + self.retry_s
                 _log.alert(f"device probe failed ({e!r}); device marked "
                            f"down for {self.retry_s:.0f}s")
+                from ..utils import events
+                events.record("classify_failover",
+                              f"device probe failed: {e!r}",
+                              retry_s=self.retry_s)
             finally:
                 with self._probe_cv:
                     self._probe_req = None
@@ -439,6 +451,10 @@ class ClassifyService:
                 self._device_down_until = time.monotonic() + self.retry_s
                 _log.alert(f"device classify failed ({e!r}); serving from "
                            f"host oracle, retry in {self.retry_s:.0f}s")
+                from ..utils import events
+                events.record("classify_failover",
+                              f"device classify failed: {e!r}",
+                              batch=n, retry_s=self.retry_s)
         if idxs is None:
             t0 = time.monotonic()
             idxs = self._oracle_batch(kind, matcher, snap, reqs)

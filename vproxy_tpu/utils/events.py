@@ -1,0 +1,87 @@
+"""Flight recorder — fixed-size in-memory ring of data-plane events.
+
+The black-box counterpart to utils/metrics: metrics answer "how much /
+how fast", the recorder answers "what happened around second X". Event
+sources (all low-rate relative to the bytes they describe):
+
+* connection lifecycle — splice-pump sessions opening/closing with byte
+  counts and the error that ended them (components/tcplb.py);
+* loop stalls — any event-loop callback that held the loop thread past
+  the stall threshold, the known GIL-contention p999 culprit
+  (net/eventloop.py);
+* classify failovers — device dispatch errors that degraded a batch to
+  the host oracle (rules/service.py);
+* health-check up/down edges (components/servergroup.py).
+
+Dumped over HTTP at /events (next to /metrics, /lsof, /jstack —
+utils/metrics.launch_inspection_http) and via the control-plane command
+`list event-log`. The ring is process-global and bounded: recording is
+a lock + deque append, safe from any thread, and never blocks on I/O.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+DEFAULT_CAPACITY = 1024
+
+
+class FlightRecorder:
+    _instance: Optional["FlightRecorder"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self.dropped = 0  # events evicted by ring wraparound
+
+    @classmethod
+    def get(cls) -> "FlightRecorder":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = FlightRecorder()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Test hook: drop the singleton (a new one lazily respawns)."""
+        with cls._ilock:
+            cls._instance = None
+
+    def record(self, kind: str, msg: str, **fields) -> None:
+        ev = {"seq": 0, "ts": time.time(), "mono": time.monotonic(),
+              "kind": kind, "msg": msg}
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            ev["seq"] = next(self._seq)
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(ev)
+
+    def snapshot(self, last: int = 0) -> list:
+        """Events oldest-first; `last` > 0 trims to the newest N."""
+        with self._lock:
+            evs = list(self._ring)
+        return evs[-last:] if last > 0 else evs
+
+    def lines(self, last: int = 0) -> list:
+        """Human-form rendering for the command surface."""
+        out = []
+        for ev in self.snapshot(last):
+            extras = " ".join(
+                f"{k}={ev[k]}" for k in sorted(ev)
+                if k not in ("seq", "ts", "mono", "kind", "msg"))
+            stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+            out.append(f"[{ev['seq']}] {stamp} {ev['kind']}: {ev['msg']}"
+                       + (f" ({extras})" if extras else ""))
+        return out
+
+
+def record(kind: str, msg: str, **fields) -> None:
+    """Module-level convenience: FlightRecorder.get().record(...)."""
+    FlightRecorder.get().record(kind, msg, **fields)

@@ -38,6 +38,9 @@ class Metric:
         v_str = "%d" % v if float(v).is_integer() else repr(float(v))
         return f"{self.name}{_fmt_labels(self.labels)} {v_str}"
 
+    def sample_lines(self) -> List[str]:
+        return [self.sample_line()]
+
 
 class Counter(Metric):
     mtype = "counter"
@@ -85,6 +88,116 @@ class GaugeF(Metric):
         return float(self.fn())
 
 
+class Histogram(Metric):
+    """Fixed log2-bucket histogram with Prometheus exposition.
+
+    Bucket upper bounds are 1, 2, 4, ... 2**(buckets-1) in the metric's
+    own unit (latencies here use microseconds, hence the `_us` naming
+    convention), plus the implicit +Inf bucket. The hot path is one
+    uncontended lock acquisition, a bit_length() bucket pick and three
+    integer adds — no allocation, no percentile math.
+
+    An optional reservoir (ring of the last N raw samples) makes
+    percentiles() EXACT over the recent window instead of log2-bucket
+    estimates; the classify latency contract (BASELINE p99 < 50us) is
+    measured through it, while /metrics scrapes see the cumulative
+    buckets either way.
+    """
+    mtype = "histogram"
+
+    def __init__(self, name: str, labels: Optional[Dict[str, str]] = None,
+                 buckets: int = 27, reservoir: int = 0):
+        super().__init__(name, labels)
+        self._bounds = [1 << k for k in range(buckets)]
+        self._counts = [0] * (buckets + 1)  # + the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+        self._res_cap = reservoir
+        self._res: List[float] = [0.0] * reservoir
+        self._res_n = 0
+
+    def _bucket_of(self, v: float) -> int:
+        if v <= 1.0:
+            return 0
+        iv = int(v)
+        if iv < v:
+            iv += 1
+        return min((iv - 1).bit_length(), len(self._bounds))
+
+    def observe(self, v: float) -> None:
+        i = self._bucket_of(v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if self._res_cap:
+                self._res[self._res_n % self._res_cap] = v
+                self._res_n += 1
+
+    def value(self) -> float:
+        return self._count
+
+    def sample_lines(self) -> List[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        out = []
+        cum = 0
+        for bound, n in zip(self._bounds, counts):
+            cum += n
+            lbl = _fmt_labels({**self.labels, "le": str(bound)})
+            out.append(f"{self.name}_bucket{lbl} {cum}")
+        lbl = _fmt_labels({**self.labels, "le": "+Inf"})
+        out.append(f"{self.name}_bucket{lbl} {total}")
+        base = _fmt_labels(self.labels)
+        s_str = "%d" % s if float(s).is_integer() else repr(float(s))
+        out.append(f"{self.name}_sum{base} {s_str}")
+        out.append(f"{self.name}_count{base} {total}")
+        return out
+
+    def percentiles(self, qs=(50.0, 99.0, 99.9)) -> Optional[Dict[str, float]]:
+        """-> {"n", "p50", "p99", "p999", ...} or None when empty.
+        Exact over the reservoir window when one is configured, else a
+        log-linear estimate from the cumulative buckets."""
+        with self._lock:
+            if self._count == 0:
+                return None
+            if self._res_cap and self._res_n:
+                n = min(self._res_n, self._res_cap)
+                window = sorted(self._res[:n])
+                out = {"n": self._res_n}
+                for q in qs:
+                    i = min(n - 1, max(0, int(round(q / 100.0 * (n - 1)))))
+                    out[_q_key(q)] = float(window[i])
+                return out
+            counts = list(self._counts)
+            total = self._count
+        out = {"n": total}
+        for q in qs:
+            out[_q_key(q)] = _bucket_quantile(self._bounds, counts, total,
+                                              q / 100.0)
+        return out
+
+
+def _q_key(q: float) -> str:
+    return "p" + ("%g" % q).replace(".", "")
+
+
+def _bucket_quantile(bounds, counts, total, q: float) -> float:
+    """Log-linear interpolation inside the winning log2 bucket."""
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for bound, n in zip(bounds, counts):
+        if cum + n >= rank and n > 0:
+            frac = (rank - cum) / n
+            return lo + frac * (bound - lo)
+        cum += n
+        lo = float(bound)
+    return float(bounds[-1] * 2)  # landed in +Inf
+
+
 class MetricsRegistry:
     def __init__(self):
         self._metrics: List[Metric] = []
@@ -109,6 +222,11 @@ class MetricsRegistry:
     def gauge_f(self, name: str, fn, **labels) -> GaugeF:
         return self.add(GaugeF(name, fn, labels))  # type: ignore[return-value]
 
+    def histogram(self, name: str, buckets: int = 27, reservoir: int = 0,
+                  **labels) -> Histogram:
+        return self.add(Histogram(name, labels, buckets=buckets,
+                                  reservoir=reservoir))  # type: ignore[return-value]
+
     def prometheus_text(self) -> str:
         with self._lock:
             metrics = list(self._metrics)
@@ -119,7 +237,8 @@ class MetricsRegistry:
         for name in sorted(by_name):
             mtype, ms = by_name[name]
             out.append(f"# TYPE {name} {mtype}")
-            out.extend(m.sample_line() for m in ms)
+            for m in ms:
+                out.extend(m.sample_lines())
         return "\n".join(out) + ("\n" if out else "")
 
 
@@ -133,6 +252,8 @@ class GlobalInspection:
         self.registry = MetricsRegistry()
         self._loops: Dict[int, object] = {}  # id(loop) -> SelectorEventLoop
         self._lock = threading.Lock()
+        # (name, sorted-label-items) -> Metric for get-or-create users
+        self._named: Dict[tuple, Metric] = {}
         self.direct_memory_bytes = self.registry.gauge(
             "vproxy_direct_memory_bytes_current")
         self.registry.gauge_f("vproxy_event_loop_count",
@@ -147,12 +268,91 @@ class GlobalInspection:
                   "oracle_queries", "failovers", "max_batch"):
             self.registry.gauge_f(
                 f"vproxy_classify_{k}", lambda k=k: self._classify_stat(k))
+        # native splice-pump counters (net/native/vtl.cpp, the hot-byte
+        # black box): bytes spliced, write syscalls, short writes, TLS
+        # handshakes — read through the C-ABI getter in net/vtl.py
+        for i, k in enumerate(("bytes", "splice_calls", "short_writes",
+                               "tls_handshakes")):
+            self.registry.gauge_f(f"vproxy_pump_{k}_total",
+                                  lambda i=i: self._pump_counter(i))
+        # event-loop health: worst timer slip and longest single callback
+        # across all live loops since the previous scrape (the known
+        # GIL-contention p999 culprits); reading resets the window
+        self.registry.gauge_f("vproxy_loop_timer_slip_us_max",
+                              lambda: self._loop_health("slip"))
+        self.registry.gauge_f("vproxy_loop_callback_us_max",
+                              lambda: self._loop_health("cb"))
 
     @staticmethod
     def _classify_stat(key: str) -> float:
         from ..rules.service import ClassifyService
         svc = ClassifyService._instance
         return 0.0 if svc is None else float(getattr(svc.stats, key))
+
+    @staticmethod
+    def _pump_counter(i: int) -> float:
+        from ..net import vtl
+        return float(vtl.pump_counters()[i])
+
+    def _loop_health(self, key: str) -> float:
+        with self._lock:
+            loops = list(self._loops.values())
+        worst = 0.0
+        for lp in loops:
+            take = getattr(lp, "take_health", None)
+            if take is not None:
+                worst = max(worst, take(key))
+        return worst * 1e6
+
+    def bench_snapshot(self) -> dict:
+        """The BENCH-artifact view of /metrics: per-series percentiles
+        for every histogram plus raw values for counters/gauges, keyed
+        by exposition name with label values folded in
+        (vproxy_accept_stage_us{stage="acl"} ->
+        "vproxy_accept_stage_us.acl"). bench.py/bench_host.py/
+        bench_switch.py merge this into the BENCH json so the latency
+        contract and drop rates land in the artifact."""
+        with self.registry._lock:
+            metrics = list(self.registry._metrics)
+        out: Dict[str, object] = {}
+        for m in metrics:
+            key = m.name
+            if m.labels:
+                key += "." + ".".join(
+                    str(v) for _, v in sorted(m.labels.items()))
+            try:
+                if isinstance(m, Histogram):
+                    pct = m.percentiles()
+                    if pct is not None:
+                        out[key] = {k: (round(v, 1)
+                                        if isinstance(v, float) else v)
+                                    for k, v in pct.items()}
+                else:
+                    out[key] = m.value()
+            except Exception:
+                pass  # a dead GaugeF fn must not sink the artifact
+        return out
+
+    # ------------------------------------------- named get-or-create
+
+    def get_counter(self, name: str, **labels) -> Counter:
+        return self._get_named(name, labels,
+                               lambda: Counter(name, labels))  # type: ignore[return-value]
+
+    def get_histogram(self, name: str, buckets: int = 27, reservoir: int = 0,
+                      **labels) -> Histogram:
+        return self._get_named(
+            name, labels, lambda: Histogram(name, labels, buckets=buckets,
+                                            reservoir=reservoir))  # type: ignore[return-value]
+
+    def _get_named(self, name: str, labels: dict, mk) -> Metric:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._named.get(key)
+            if m is None:
+                m = self._named[key] = mk()
+                self.registry.add(m)
+        return m
 
     @classmethod
     def get(cls) -> "GlobalInspection":
@@ -206,11 +406,30 @@ class GlobalInspection:
         return self.registry.prometheus_text()
 
 
+# accept-path span timers (components/tcplb.py + components/upstream.py):
+# one histogram family, labeled by stage — acl (accept->ACL verdict),
+# classify (hint submit->index), backend_pick (group/WRR selection),
+# handover (backend connect->pump running), total (accept->pump running).
+# Local memo keeps the hot path at one dict hit; a racy double-create
+# resolves to the same metric through get_histogram's dedup.
+_ACCEPT_STAGE_HISTS: Dict[str, Histogram] = {}
+
+
+def accept_stage_observe(stage: str, seconds: float) -> None:
+    h = _ACCEPT_STAGE_HISTS.get(stage)
+    if h is None:
+        h = _ACCEPT_STAGE_HISTS[stage] = GlobalInspection.get().get_histogram(
+            "vproxy_accept_stage_us", stage=stage)
+    h.observe(seconds * 1e6)
+
+
 def launch_inspection_http(loop, ip: str, port: int):
-    """Serve /metrics, /lsof, /jstack, /healthz — the reference's
-    `-Dglobal_inspection=host:port` server (Main.java:85-104). Returns
-    the HttpServer (close() to stop)."""
+    """Serve /metrics, /lsof, /jstack, /events, /healthz — the
+    reference's `-Dglobal_inspection=host:port` server (Main.java:
+    85-104) plus the flight-recorder dump. Returns the HttpServer
+    (close() to stop)."""
     from ..lib.vserver import HttpServer
+    from .events import FlightRecorder
 
     gi = GlobalInspection.get()
     srv = HttpServer(loop)
@@ -221,6 +440,15 @@ def launch_inspection_http(loop, ip: str, port: int):
             .header("Content-Type", "text/plain").end(gi.open_fd_dump()))
     srv.get("/jstack", lambda ctx: ctx.resp
             .header("Content-Type", "text/plain").end(gi.stack_trace_dump()))
+
+    def events(ctx) -> None:
+        try:
+            last = int(ctx.req.query.get("n", "0"))
+        except ValueError:
+            last = 0
+        ctx.resp.end(FlightRecorder.get().snapshot(last))
+
+    srv.get("/events", events)
     srv.get("/healthz", lambda ctx: ctx.resp.end(b"OK"))
     srv.listen(port, ip)
     return srv

@@ -54,6 +54,7 @@ import numpy as np
 from ..rules.ir import Proto
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
+from . import swmetrics
 
 _log = Logger("swfast")
 
@@ -304,18 +305,26 @@ class SwitchFastPath:
             out = ifaces[int(u)]
             many = getattr(out, "send_vxlan_raw_many", None)
             if many is not None:
+                group = np.nonzero(if_idx == u)[0].tolist()
                 datas = [blk[j * w: j * w + lens_l[j]]
-                         for j in np.nonzero(if_idx == u)[0].tolist()
+                         for j in group
                          if row_if is None or out is not row_if[rows_l[j]]]
+                swmetrics.drop("same_iface", len(group) - len(datas))
                 if datas:
                     many(sw, datas)  # one sendmmsg per iface group
+                    swmetrics.forward("fast", len(datas))
                 continue
             raw = out.send_vxlan_raw
+            sent = skipped = 0
             for j in np.nonzero(if_idx == u)[0].tolist():
                 if row_if is not None and out is row_if[rows_l[j]]:
+                    skipped += 1
                     continue  # consumed: same-iface drop
                 o = j * w
                 raw(sw, blk[o: o + lens_l[j]])
+                sent += 1
+            swmetrics.drop("same_iface", skipped)
+            swmetrics.forward("fast", sent)
 
     @staticmethod
     def _last_per_key(keys: np.ndarray):
@@ -388,6 +397,7 @@ class SwitchFastPath:
             if not acl_default:
                 # deny-all with no rules: every bare row is consumed
                 admitted = np.zeros(n, bool)
+                swmetrics.drop("acl_deny", int(bare.sum()))
             else:
                 admitted = bare
             keep = ~bare
@@ -405,6 +415,7 @@ class SwitchFastPath:
             # like the slow path's allow_batch filter; unparseable
             # senders go to the slow path whose ACL handles v6 families
             keep = ~bare | (bare & ~src_ok)
+            swmetrics.drop("acl_deny", int((bare & src_ok & ~verdict).sum()))
         leftovers = [burst[i] for i in np.nonzero(keep)[0]]
         if not admitted.any():
             return leftovers, None
@@ -486,6 +497,7 @@ class SwitchFastPath:
             grp = rows[vni_eff[rows] == vni]
             net = sw.networks.get(int(vni))
             if net is None:
+                swmetrics.drop("unknown_vni", len(grp))
                 continue  # consumed: dropped like the slow path
             # learn src macs (multicast srcs are not learned): last
             # occurrence per mac — the per-packet dict writes of the
@@ -518,6 +530,21 @@ class SwitchFastPath:
             l3 = l3[len_ok[l3]]
             if not len(l3):
                 continue
+            # verify the INBOUND header checksum before the incremental
+            # rewrite path touches it: the object path re-serializes via
+            # Ipv4.to_bytes (fresh checksum), so a corrupt frame must go
+            # there for bit parity — and gets counted while at it
+            hdr = mat[l3, _IP:_IP + 20].astype(np.int64)
+            hsum = (hdr[:, 0::2] * 256 + hdr[:, 1::2]).sum(axis=1)
+            hsum = (hsum & 0xFFFF) + (hsum >> 16)
+            hsum = (hsum & 0xFFFF) + (hsum >> 16)
+            csum_ok = hsum == 0xFFFF
+            if not csum_ok.all():
+                swmetrics.slowpath("bad_csum", int((~csum_ok).sum()))
+                slow[l3[~csum_ok]] = True
+                l3 = l3[csum_ok]
+                if not len(l3):
+                    continue
             # arp-learn src ip -> src mac (l3_input does this for IPv4):
             # last occurrence per ip, deduped across bursts
             src32 = (mat[l3, _IP_SRC].astype(np.int64) << 24) | \
@@ -583,6 +610,7 @@ class SwitchFastPath:
             else:
                 cell = np.zeros(len(l3), np.int64)
             # route miss = consumed drop (slow path drops too)
+            swmetrics.drop("route_miss", int((cell == 0).sum()))
             hit = l3[cell > 0]
             ridx = cell[cell > 0] - 1
             slow[hit[via[ridx]]] = True  # gateway routes: object path
@@ -632,6 +660,7 @@ class SwitchFastPath:
             target = sw.networks.get(int(tv))
             sub = rows[tvnis == tv]
             if target is None:
+                swmetrics.drop("unknown_vni", len(sub))
                 continue  # consumed: _route_with drops unknown vni
             d32 = dst32[tvnis == tv]
             akeys, amacs = self._arp_view(target)

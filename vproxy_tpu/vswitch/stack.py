@@ -13,6 +13,7 @@ import struct
 from typing import Optional
 
 from ..utils.log import Logger
+from . import swmetrics
 from .network import VpcNetwork
 from .packets import (ARP_REPLY, ARP_REQUEST, BROADCAST_MAC, ETHER_TYPE_ARP,
                       ETHER_TYPE_IPV4, ETHER_TYPE_IPV6, ICMP_ECHO_REPLY,
@@ -62,6 +63,7 @@ class NetworkStack:
     def input_vxlan(self, pkt: Vxlan, src_iface) -> None:
         net = self.sw.networks.get(pkt.vni)
         if net is None:
+            swmetrics.drop("unknown_vni")
             return
         ether = pkt.ether
         from ..utils.mirror import Mirror
@@ -84,13 +86,19 @@ class NetworkStack:
         if out is not None:
             if out is not src_iface:
                 out.send_vxlan(self.sw, pkt)
+                swmetrics.forward("slow")
+            else:
+                swmetrics.drop("same_iface")
             return
         self._flood(net, pkt, src_iface)
 
     def _flood(self, net: VpcNetwork, pkt: Vxlan, src_iface) -> None:
+        sent = 0
         for iface in self.sw.ifaces_for_vni(net.vni):
             if iface is not src_iface:
                 iface.send_vxlan(self.sw, pkt)
+                sent += 1
+        swmetrics.forward("slow", sent)
 
     def send_ether(self, net: VpcNetwork, ether: Ethernet) -> None:
         """Emit a switch-originated frame into the VPC (L2 path)."""
@@ -107,6 +115,7 @@ class NetworkStack:
         out = net.macs.lookup(ether.dst)
         if out is not None:
             out.send_vxlan(self.sw, pkt)
+            swmetrics.forward("slow")
         else:
             self._flood(net, pkt, None)
 
@@ -222,6 +231,7 @@ class NetworkStack:
     def _route_with(self, net: VpcNetwork, ether: Ethernet, ip, v6: bool,
                     rule) -> None:
         if rule is None:
+            swmetrics.drop("route_miss")
             return
         # ttl/hop-limit handling
         if v6:
@@ -236,6 +246,7 @@ class NetworkStack:
         if rule.to_vni:
             target = self.sw.networks.get(rule.to_vni)
             if target is None:
+                swmetrics.drop("unknown_vni")
                 return
             self._deliver(target, ip, v6)
             return
@@ -243,6 +254,7 @@ class NetworkStack:
             gw_mac = net.arps.lookup(rule.via_ip)
             src = net.ips.first_in(net.v6net if v6 and net.v6net else net.v4net)
             if gw_mac is None:
+                swmetrics.drop("arp_unresolved")
                 if src is not None and not v6:
                     self._arp_request(net, src[1], src[0], rule.via_ip)
                 return
@@ -258,6 +270,7 @@ class NetworkStack:
         src = net.ips.first_in(net.v6net if v6 and net.v6net else net.v4net)
         src_mac = src[1] if src is not None else b"\x02\x00\x00\x00\x00\x01"
         if dst_mac is None:
+            swmetrics.drop("arp_unresolved")
             if not v6 and src is not None:
                 self._arp_request(net, src[1], src[0], ip.dst)
             return

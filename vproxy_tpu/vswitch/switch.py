@@ -21,6 +21,7 @@ from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import Proto
 from ..utils.log import Logger
 from ..utils.ip import Network, parse_ip
+from . import swmetrics
 from .iface import (BareVXLanIface, Iface, RemoteSwitchIface, TapIface,
                     UserClientIface, UserIface, tap_supported)
 from .network import ARP_TABLE_TIMEOUT, MAC_TABLE_TIMEOUT, VpcNetwork
@@ -311,25 +312,31 @@ class Switch:
     def send_udp(self, data: bytes, remote: tuple[str, int]) -> None:
         if self._fd is not None:
             try:
-                vtl.sendto(self._fd, data, remote[0], remote[1])
+                if vtl.sendto(self._fd, data, remote[0], remote[1]) < 0:
+                    swmetrics.drop("egress_short_write")  # EAGAIN
             except OSError:
-                pass
+                swmetrics.drop("egress_short_write")
 
     def send_udp_many(self, datas: list, remote: tuple[str, int]) -> int:
         """Batched same-destination egress (fast-path groups): one
         sendmmsg when the native provider offers it. -> count accepted
-        by the kernel (datagram drops under pressure are normal)."""
+        by the kernel (datagram drops under pressure are normal — and
+        counted as egress_short_write so the drop rate is visible)."""
         if self._fd is None:
             return 0
         try:
             if vtl.PROVIDER == "native" and hasattr(vtl, "sendmmsg"):
-                return vtl.sendmmsg(self._fd, datas, remote[0], remote[1])
-            n = 0
-            for d in datas:
-                vtl.sendto(self._fd, d, remote[0], remote[1])
-                n += 1
+                n = vtl.sendmmsg(self._fd, datas, remote[0], remote[1])
+            else:
+                n = 0
+                for d in datas:
+                    if vtl.sendto(self._fd, d, remote[0], remote[1]) < 0:
+                        break
+                    n += 1
+            swmetrics.drop("egress_short_write", len(datas) - n)
             return n
         except OSError:
+            swmetrics.drop("egress_short_write", len(datas))
             return 0
 
     def _register(self, key, iface: Iface, permanent: bool = False):
@@ -452,6 +459,7 @@ class Switch:
         return pkt, known
 
     def _input_batch(self, burst) -> None:
+        swmetrics.rx(len(burst))
         pending = None
         if self.fastpath is not None:
             # leftovers (control frames, non-bare, v6) run through the
@@ -477,6 +485,7 @@ class Switch:
                 [self.bind_port] * len(bare))
             admitted = [self._resolve_bare(pkt, remote)
                         for (pkt, remote), ok in zip(bare, allowed) if ok]
+            swmetrics.drop("acl_deny", len(bare) - len(admitted))
         if admitted:
             self.stack.input_vxlan_batch(admitted)
         for data, remote in other:
@@ -489,6 +498,7 @@ class Switch:
         if pkt is not None:
             if not self.bare_access.allow(Proto.UDP, parse_ip(remote[0]),
                                           self.bind_port):
+                swmetrics.drop("acl_deny")
                 return
             pkt, known = self._resolve_bare(pkt, remote)
             self.stack.input_vxlan(pkt, known)

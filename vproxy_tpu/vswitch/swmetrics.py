@@ -1,0 +1,50 @@
+"""Labeled switch data-plane counters.
+
+The switch consumes frames at six distinct points (ACL deny, unknown
+VNI, route miss, same-iface suppression, egress backpressure) with —
+until now — zero accounting, which is how a 68% drop rate stays a
+mystery. Every consumed frame increments
+`vproxy_switch_drops_total{reason=...}`; frames demoted from the
+vectorized fast path to the object pipeline increment
+`vproxy_switch_slowpath_total{reason=...}` (not drops — they are still
+forwarded); egressed datagrams land in
+`vproxy_switch_forwards_total{path=fast|slow}` and drained ones in
+`vproxy_switch_rx_total`, so drop RATE is computable from /metrics
+alone.
+
+Counters are process-global (utils/metrics GlobalInspection) with a
+module-local memo so the hot path pays one dict hit, no lock.
+"""
+from __future__ import annotations
+
+from ..utils.metrics import Counter, GlobalInspection
+
+_memo: dict = {}
+
+
+def _ctr(name: str, **labels) -> Counter:
+    key = (name, tuple(sorted(labels.items())))
+    c = _memo.get(key)
+    if c is None:
+        c = _memo[key] = GlobalInspection.get().get_counter(name, **labels)
+    return c
+
+
+def drop(reason: str, n: int = 1) -> None:
+    if n > 0:
+        _ctr("vproxy_switch_drops_total", reason=reason).incr(n)
+
+
+def slowpath(reason: str, n: int = 1) -> None:
+    if n > 0:
+        _ctr("vproxy_switch_slowpath_total", reason=reason).incr(n)
+
+
+def forward(path: str, n: int = 1) -> None:
+    if n > 0:
+        _ctr("vproxy_switch_forwards_total", path=path).incr(n)
+
+
+def rx(n: int) -> None:
+    if n > 0:
+        _ctr("vproxy_switch_rx_total").incr(n)
