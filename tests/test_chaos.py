@@ -1,0 +1,49 @@
+"""Chaos scenario (tools/chaos.py) — the failure-containment acceptance
+run: backend kill mid-traffic with retry failover, one-RTT passive
+ejection, backoff re-admission, device-drop degradation, drain.
+
+Marked `chaos` (and `slow`) so tier-1 skips it; run with
+`pytest -m chaos` or `python tools/chaos.py`.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_scenario_floor():
+    import chaos
+
+    report = chaos.run(clients=4, requests=120, payload_len=4096,
+                       eject_base_s=0.5, drain_s=10.0)
+
+    # >= 99% of sessions complete with correct byte counts (retry failover)
+    assert report["success_rate"] >= 0.99, report
+    assert report["warmup"]["fail"] == 0, report["warmup"]
+
+    # the refused backend was passively ejected within the failure
+    # threshold — far inside the 60s hc interval, so not the checker
+    assert report["ejected"], report
+    assert report["eject_latency_s"] is not None \
+        and report["eject_latency_s"] < 5.0, report
+
+    # disarm -> backoff re-admission, and it serves again
+    assert report["readmitted"], report
+    assert report["victim_served_after_readmit"], report
+
+    # device drop degraded to the host oracle and still delivered
+    assert report["classify"]["delivered"], report["classify"]
+    assert report["classify"]["failovers"] >= 1, report["classify"]
+    assert report["classify"]["answers"] == [-1, 0], report["classify"]
+
+    # drain mid-traffic: new accepts shed, in-flight finish, clean exit
+    # within the drain window
+    assert report["drain_sheds_new_accepts"], report
+    assert report["drain_inflight_alive"], report
+    assert report["drain_clean"], report
+    assert report["drain_elapsed_s"] < 10.0, report

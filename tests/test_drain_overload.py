@@ -1,0 +1,173 @@
+"""Graceful drain + overload guard: SIGTERM/`drain` lets in-flight pumps
+finish while listeners close and /healthz flips to draining; max_sessions
+sheds accepts close-on-accept instead of queueing unboundedly."""
+import socket
+import time
+
+import pytest
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.servergroup import HealthCheckConfig, ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.utils import lifecycle
+from vproxy_tpu.utils.events import FlightRecorder
+from vproxy_tpu.utils.metrics import GlobalInspection
+
+from tests.test_tcplb import IdServer, fast_hc, stack, tcp_get_id, wait_healthy  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    lifecycle.reset()
+    FlightRecorder.reset()
+    yield
+    lifecycle.reset()
+
+
+def _mk_lb(stack, alias, **kw):
+    elg = stack["make_elg"](1)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup(f"{alias}-g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    wait_healthy(g, 1)
+    ups = Upstream(f"{alias}-u")
+    ups.add(g)
+    lb = TcpLB(alias, elg, elg, "127.0.0.1", 0, ups, protocol="tcp", **kw)
+    stack["lbs"].append(lb)
+    lb.start()
+    return lb
+
+
+def test_drain_lets_sessions_finish_and_sheds_new(stack):
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+
+    lb = _mk_lb(stack, "lb-drain")
+    app = Application.create(workers=1)
+    try:
+        app.tcp_lbs["lb-drain"] = lb
+        # a live echo session that outlives the drain request
+        c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+        c.settimeout(5)
+        assert c.recv(10) == b"A"
+
+        drained = []
+        app.on_drain_request.append(lambda: drained.append(True))
+        assert Command.execute(app, "drain") == "OK"
+        assert Command.execute(app, "drain") == "already draining"
+        assert drained == [True]
+        assert lifecycle.is_draining()
+        assert lb.draining and lb.server_socks == []
+
+        # the in-flight session keeps moving bytes through the pump
+        c.sendall(b"still-here")
+        assert c.recv(64) == b"still-here"
+
+        # new connections are refused (listener closed) or shed on accept
+        try:
+            c2 = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                          timeout=2)
+            c2.settimeout(2)
+            assert c2.recv(16) == b""
+            c2.close()
+        except OSError:
+            pass  # connection refused: equally fine
+
+        # drain_wait times out while the session lives, completes after
+        assert app.drain_wait(0.3) is False
+        c.close()
+        assert app.drain_wait(5) is True
+        kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+        assert "drain" in kinds
+    finally:
+        app.tcp_lbs.pop("lb-drain", None)
+        app.close()
+
+
+def test_healthz_flips_to_draining(stack):
+    """Both healthz surfaces (inspection server + HttpController) report
+    draining with a 503 once drain begins."""
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.http_controller import HttpController
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.utils.metrics import launch_inspection_http
+    from tests.test_metrics import http_get
+
+    loop = SelectorEventLoop("drain-hz")
+    loop.loop_thread()
+    time.sleep(0.05)
+    srv = launch_inspection_http(loop, "127.0.0.1", 0)
+    app = Application.create(workers=1)
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    try:
+        st, body = http_get(srv.port, "/healthz")
+        assert st == 200 and body == b"OK"
+        st, body = http_get(ctl.bind_port, "/healthz")
+        assert st == 200 and b"ok" in body
+
+        app.request_drain()
+        st, body = http_get(srv.port, "/healthz")
+        assert st == 503 and body == b"draining"
+        st, body = http_get(ctl.bind_port, "/healthz")
+        assert st == 503 and b"draining" in body
+    finally:
+        ctl.stop()
+        srv.close()
+        loop.close()
+        app.close()
+
+
+def test_overload_guard_sheds_past_max_sessions(stack):
+    lb = _mk_lb(stack, "lb-over", max_sessions=1)
+    ctr = GlobalInspection.get().get_counter(
+        "vproxy_lb_overload_total", lb="lb-over")
+    base = ctr.value()
+
+    c1 = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c1.settimeout(5)
+    assert c1.recv(10) == b"A"  # session 1 established (spliced)
+
+    c2 = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c2.settimeout(5)
+    assert c2.recv(16) == b""  # shed close-on-accept, not served
+    c2.close()
+    assert ctr.value() == base + 1
+    assert any(e["kind"] == "overload"
+               for e in FlightRecorder.get().snapshot())
+
+    # capacity freed -> accepts flow again
+    c1.close()
+    deadline = time.time() + 5
+    while lb.active_sessions and time.time() < deadline:
+        time.sleep(0.02)
+    assert tcp_get_id(lb.bind_port) == "A"
+    # the shed connection never counted as accepted
+    assert lb.accepted == 2
+
+    # hot-set like `update tcp-lb ... max-sessions n`
+    lb.max_sessions = 2
+    c1 = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    assert c1.recv(10) == b"A"
+    c2 = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    assert c2.recv(10) == b"A"
+    c1.close()
+    c2.close()
+
+
+def test_drain_then_stop_is_clean(stack):
+    """begin_drain followed by stop() must not double-close listeners or
+    wedge; a fresh LB can rebind the same port after."""
+    lb = _mk_lb(stack, "lb-dstop")
+    port = lb.bind_port
+    lb.begin_drain()
+    lb.begin_drain()  # idempotent
+    lb.stop()
+    lb2 = TcpLB("lb-dstop2", lb.acceptor, lb.worker, "127.0.0.1", port,
+                lb.backend, protocol="tcp")
+    stack["lbs"].append(lb2)
+    lb2.start()
+    assert tcp_get_id(port) == "A"

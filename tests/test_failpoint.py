@@ -1,0 +1,150 @@
+"""utils/failpoint — deterministic fault-injection sites: arming gates
+(probability/count/match), env bootstrap, command verbs, GET /faults."""
+import socket
+import time
+
+import pytest
+
+from vproxy_tpu.utils import failpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def test_unknown_site_rejected():
+    with pytest.raises(ValueError):
+        failpoint.arm("definitely.not.a.site")
+    with pytest.raises(ValueError):
+        failpoint.arm("backend.connect.refuse", probability=0.0)
+    with pytest.raises(ValueError):
+        failpoint.arm("backend.connect.refuse", count=0)
+
+
+def test_hit_gates_count_and_match():
+    failpoint.arm("backend.connect.refuse", count=2, match=":8080")
+    assert not failpoint.hit("backend.connect.refuse", "10.0.0.1:9090")
+    assert failpoint.hit("backend.connect.refuse", "10.0.0.1:8080")
+    assert failpoint.hit("backend.connect.refuse", "10.0.0.2:8080")
+    # count exhausted -> auto-disarm
+    assert not failpoint.hit("backend.connect.refuse", "10.0.0.1:8080")
+    assert failpoint.active() == []
+
+
+def test_probability_is_seeded_deterministic():
+    failpoint.arm("pump.abort", probability=0.5, seed=42)
+    seq1 = [failpoint.hit("pump.abort") for _ in range(64)]
+    failpoint.arm("pump.abort", probability=0.5, seed=42)
+    seq2 = [failpoint.hit("pump.abort") for _ in range(64)]
+    assert seq1 == seq2
+    assert any(seq1) and not all(seq1)
+
+
+def test_active_snapshot_counts_hits():
+    failpoint.arm("hc.force_down")
+    failpoint.hit("hc.force_down", "g/s 1.2.3.4:80")
+    failpoint.hit("hc.force_down", "g/s 1.2.3.4:80")
+    (f,) = failpoint.active()
+    assert f["name"] == "hc.force_down" and f["hits"] == 2
+    assert failpoint.disarm("hc.force_down")
+    assert not failpoint.disarm("hc.force_down")
+
+
+def test_env_bootstrap_spec(monkeypatch):
+    monkeypatch.setenv(
+        "VPROXY_TPU_FAILPOINTS",
+        "backend.connect.refuse:0.5:3@:9999, pump.abort, bogus.site")
+    failpoint._bootstrap_env()
+    names = {f["name"]: f for f in failpoint.active()}
+    assert names["backend.connect.refuse"]["probability"] == 0.5
+    assert names["backend.connect.refuse"]["count"] == 3
+    assert names["backend.connect.refuse"]["match"] == ":9999"
+    assert names["pump.abort"]["probability"] == 1.0
+    assert "bogus.site" not in names  # skipped loudly, not fatal
+
+
+def test_connection_connect_refuse_and_hang():
+    """The wired site in net/connection.py: refuse raises ECONNREFUSED
+    synchronously; hang never completes and never errors."""
+    from vproxy_tpu.net.connection import Connection, Handler
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    loop = SelectorEventLoop("fp-conn")
+    loop.loop_thread()
+    try:
+        failpoint.arm("backend.connect.refuse", match=f":{port}")
+        with pytest.raises(OSError):
+            loop.call_sync(
+                lambda: Connection.connect(loop, "127.0.0.1", port))
+        # refuse disarmed only by count/clear; clear and arm hang
+        failpoint.clear()
+        failpoint.arm("backend.connect.hang", match=f":{port}")
+        seen = []
+
+        class H(Handler):
+            def on_connected(self, conn):
+                seen.append("connected")
+
+            def on_closed(self, conn, err):
+                seen.append("closed")
+
+        def mk():
+            c = Connection.connect(loop, "127.0.0.1", port)
+            c.set_handler(H())
+            return c
+
+        conn = loop.call_sync(mk)
+        time.sleep(0.3)
+        assert seen == []  # neither connected nor errored: hung
+        loop.call_sync(conn.close)
+    finally:
+        loop.close()
+        srv.close()
+
+
+def test_command_surface_and_faults_view():
+    """add/remove fault + list fault + GET /faults on the inspection
+    server all read the same registry."""
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import CmdError, Command
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.utils.metrics import launch_inspection_http
+    from tests.test_metrics import http_get
+
+    app = Application.create(workers=1)
+    try:
+        assert Command.execute(
+            app, "add fault backend.connect.refuse probability 0.5 "
+            "count 3 match :9090") == "OK"
+        with pytest.raises(CmdError):
+            Command.execute(app, "add fault not.a.site")
+        assert Command.execute(app, "list fault") == \
+            ["backend.connect.refuse"]
+        detail = Command.execute(app, "list-detail fault")
+        assert "probability 0.5" in detail[0] and "count 3" in detail[0]
+
+        loop = SelectorEventLoop("fp-http")
+        loop.loop_thread()
+        time.sleep(0.05)
+        srv = launch_inspection_http(loop, "127.0.0.1", 0)
+        try:
+            st, body = http_get(srv.port, "/faults")
+            assert st == 200 and b"backend.connect.refuse" in body
+        finally:
+            srv.close()
+            loop.close()
+
+        assert Command.execute(
+            app, "remove fault backend.connect.refuse") == "OK"
+        with pytest.raises(CmdError):
+            Command.execute(app, "remove fault backend.connect.refuse")
+        assert Command.execute(app, "list fault") == []
+    finally:
+        app.close()

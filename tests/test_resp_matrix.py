@@ -110,6 +110,10 @@ def run(c: RespClient, line: str):
 # server-group, ...) are exercised through their owning context exactly
 # like CI.java does.
 MATRIX = [
+    # failpoint arming (docs/robustness.md) — no dependencies, ephemeral
+    # (intentionally NOT persisted, so the replay block below never sees it)
+    ("add fault pump.abort probability 0.5 count 3", "probability 0.5",
+     None, "remove fault pump.abort"),
     ("add event-loop-group elg0", None, None,
      "remove event-loop-group elg0"),
     ("add event-loop el0 to event-loop-group elg0", None, None,

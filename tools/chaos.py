@@ -1,0 +1,334 @@
+"""Chaos driver — a loopback LB under client load while failpoints toggle.
+
+The acceptance harness for the failure-containment layer
+(docs/robustness.md): builds 3 id-echo backends behind a TcpLB, hammers
+it with short byte-verified sessions, and walks the failure script:
+
+  1. warmup       — all backends healthy, traffic flows
+  2. backend kill — `backend.connect.refuse` armed on one backend
+                    mid-run; clients must keep completing (retry
+                    failover) and the refuser must be passively ejected
+                    within the failure threshold, NOT a health-check
+                    interval (the hc period here is 60s to prove it)
+  3. recovery     — fault disarmed; the backend re-admits via the eject
+                    backoff (halved on each passing probe)
+  4. device drop  — `device.dispatch.error` armed against a classify
+                    dispatch; the batch degrades to the host oracle and
+                    still delivers
+  5. drain        — `drain` issued mid-traffic: in-flight pumps finish,
+                    new accepts are shed, the process-level wait
+                    completes inside the drain window
+
+Run standalone (`python tools/chaos.py [--clients N] [--requests N]`)
+for a JSON report, or via `pytest -m chaos` (tests/test_chaos.py
+asserts the success-rate floor and every phase outcome). Kept out of
+tier-1 by the `chaos`/`slow` markers.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+from vproxy_tpu.components import servergroup as SG                # noqa: E402
+from vproxy_tpu.components.elgroup import EventLoopGroup           # noqa: E402
+from vproxy_tpu.components.servergroup import (HealthCheckConfig,  # noqa: E402
+                                               ServerGroup)
+from vproxy_tpu.components.tcplb import TcpLB                      # noqa: E402
+from vproxy_tpu.components.upstream import Upstream                # noqa: E402
+from vproxy_tpu.utils import failpoint, lifecycle                  # noqa: E402
+from vproxy_tpu.utils.events import FlightRecorder                 # noqa: E402
+
+
+class _EchoBackend:
+    """Sends its 1-byte id, then echoes; tracks sessions served."""
+
+    def __init__(self, sid: bytes):
+        self.sid = sid
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(128)
+        self.port = self.sock.getsockname()[1]
+        self.hits = 0
+        self.alive = True
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while self.alive:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            self.hits += 1
+            threading.Thread(target=self._conn, args=(c,),
+                             daemon=True).start()
+
+    def _conn(self, c):
+        try:
+            c.sendall(self.sid)
+            while True:
+                d = c.recv(65536)
+                if not d:
+                    break
+                c.sendall(d)
+        except OSError:
+            pass
+        finally:
+            c.close()
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _one_session(port: int, payload: bytes) -> str:
+    """One byte-verified session; returns the backend id or raises."""
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    try:
+        sid = c.recv(1)
+        if len(sid) != 1:
+            raise OSError("no backend id (closed early)")
+        c.sendall(payload)
+        got = b""
+        while len(got) < len(payload):
+            d = c.recv(65536)
+            if not d:
+                raise OSError(f"echo truncated at {len(got)}/{len(payload)}")
+            got += d
+        if got != payload:
+            raise OSError("echo corrupted")
+        return sid.decode()
+    finally:
+        c.close()
+
+
+def _blast(port: int, n: int, clients: int, payload: bytes):
+    """n sessions across `clients` threads -> (ok, fail, id-counts)."""
+    lock = threading.Lock()
+    stats = {"ok": 0, "fail": 0, "ids": {}}
+
+    def worker(count: int) -> None:
+        for _ in range(count):
+            try:
+                sid = _one_session(port, payload)
+                with lock:
+                    stats["ok"] += 1
+                    stats["ids"][sid] = stats["ids"].get(sid, 0) + 1
+            except OSError:
+                with lock:
+                    stats["fail"] += 1
+
+    per = max(1, n // clients)
+    ts = [threading.Thread(target=worker, args=(per,)) for _ in range(clients)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return stats
+
+
+def _classify_device_drop() -> dict:
+    """Phase 4: a device dispatch raises via the failpoint; the batch
+    must degrade to the host oracle and still deliver."""
+    from vproxy_tpu.rules.ir import Hint, HintRule
+    from vproxy_tpu.rules.service import ClassifyService
+
+    ups = Upstream("chaos-classify")
+    ups._matcher.set_rules([HintRule(host="chaos.example.com")],
+                           payload=["g0"])
+    svc = ClassifyService(mode="device")
+    delivered = []
+    done = threading.Event()
+
+    def cb(idx, payload):
+        delivered.append(idx)
+        if len(delivered) >= 2:
+            done.set()
+
+    failpoint.arm("device.dispatch.error", count=1)
+    try:
+        svc.submit_hint(ups._matcher, Hint(host="chaos.example.com"), cb)
+        svc.submit_hint(ups._matcher, Hint(host="nomatch.org"), cb)
+        ok = done.wait(20)
+    finally:
+        failpoint.disarm("device.dispatch.error")
+        svc.close()
+    return {"delivered": ok, "failovers": svc.stats.failovers,
+            "answers": sorted(delivered)}
+
+
+def run(clients: int = 4, requests: int = 120, payload_len: int = 4096,
+        eject_base_s: float = 0.5, drain_s: float = 10.0,
+        log=lambda *_: None) -> dict:
+    """Full chaos script; returns the report dict (see test_chaos.py
+    for the asserted floor on every field)."""
+    payload = os.urandom(payload_len)
+    report: dict = {}
+    saved = (SG.EJECT_FAILURES, SG.EJECT_BASE_S)
+    SG.EJECT_FAILURES, SG.EJECT_BASE_S = 3, eject_base_s
+    failpoint.clear()
+    lifecycle.reset()
+    FlightRecorder.reset()
+
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+
+    backends = [_EchoBackend(b"%d" % i) for i in range(3)]
+    elg = EventLoopGroup("chaos", 2)
+    # the refuse failpoint gates Connection.connect (the data plane),
+    # NOT the health checker's raw tcp probe — so the hc keeps passing
+    # and can never mark the victim down. Any DOWN observed below is
+    # provably passive ejection; the fast period only serves backoff
+    # halving on the re-admission side.
+    group = ServerGroup("chaos-g", elg, HealthCheckConfig(
+        timeout_ms=500, period_ms=200, up=1, down=100), "wrr")
+    for i, b in enumerate(backends):
+        group.add(f"b{i}", "127.0.0.1", b.port)
+    deadline = time.time() + 5
+    while sum(1 for s in group.servers if s.healthy) < 3:
+        if time.time() > deadline:
+            raise TimeoutError("backends never came healthy")
+        time.sleep(0.02)
+    ups = Upstream("chaos-u")
+    ups.add(group)
+    lb = TcpLB("chaos-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp")
+    lb.start()
+    app = Application.create(workers=1)
+    app.tcp_lbs["chaos-lb"] = lb
+
+    try:
+        # -------- phase 1: warmup
+        log("phase 1: warmup")
+        warm = _blast(lb.bind_port, requests, clients, payload)
+        report["warmup"] = warm
+
+        # -------- phase 2: refuse one backend mid-run
+        log("phase 2: backend kill (connect refuse)")
+        victim = group.servers[0]
+        t_arm = time.monotonic()
+        failpoint.arm("backend.connect.refuse",
+                      match=f":{backends[0].port}")
+        poll = {"eject_latency_s": None}
+
+        def watch_eject():
+            while time.monotonic() - t_arm < 10:
+                if victim.ejected:
+                    poll["eject_latency_s"] = time.monotonic() - t_arm
+                    return
+                time.sleep(0.005)
+
+        w = threading.Thread(target=watch_eject)
+        w.start()
+        kill = _blast(lb.bind_port, requests, clients, payload)
+        w.join()
+        report["kill"] = kill
+        report["eject_latency_s"] = poll["eject_latency_s"]
+        report["ejected"] = victim.ejected
+
+        # -------- phase 3: disarm -> backoff re-admission
+        log("phase 3: recovery (backoff re-admission)")
+        failpoint.clear()
+        deadline = time.time() + eject_base_s * 8 + 5
+        while not victim.healthy and time.time() < deadline:
+            time.sleep(0.02)
+        report["readmitted"] = victim.healthy
+        recov = _blast(lb.bind_port, requests // 2, clients, payload)
+        report["recovery"] = recov
+        report["victim_served_after_readmit"] = \
+            recov["ids"].get("0", 0) > 0
+
+        # -------- phase 4: device drop in the classify path
+        log("phase 4: device dispatch drop")
+        report["classify"] = _classify_device_drop()
+
+        # -------- phase 5: drain mid-traffic
+        log("phase 5: drain mid-traffic")
+        held = []
+        for _ in range(3):  # long-lived sessions that outlive the drain
+            c = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                         timeout=5)
+            c.settimeout(5)
+            assert c.recv(1)
+            held.append(c)
+        t_drain = time.monotonic()
+        assert Command.execute(app, "drain") == "OK"
+        # new accepts shed (refused or closed-on-accept)
+        shed_ok = False
+        try:
+            c2 = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                          timeout=2)
+            c2.settimeout(2)
+            shed_ok = c2.recv(8) == b""
+            c2.close()
+        except OSError:
+            shed_ok = True
+        report["drain_sheds_new_accepts"] = shed_ok
+        # in-flight sessions still move bytes, then finish
+        drained_bytes = all(
+            (c.sendall(b"drain-ok") or c.recv(16) == b"drain-ok")
+            for c in held)
+        report["drain_inflight_alive"] = drained_bytes
+        for c in held:
+            c.close()
+        report["drain_clean"] = app.drain_wait(drain_s)
+        report["drain_elapsed_s"] = time.monotonic() - t_drain
+        report["healthz"] = lifecycle.state()
+    finally:
+        SG.EJECT_FAILURES, SG.EJECT_BASE_S = saved
+        failpoint.clear()
+        lifecycle.reset()
+        app.tcp_lbs.pop("chaos-lb", None)
+        app.close()
+        lb.stop()
+        group.close()
+        for b in backends:
+            b.close()
+        elg.close()
+
+    total = (warm["ok"] + warm["fail"] + kill["ok"] + kill["fail"]
+             + recov["ok"] + recov["fail"])
+    ok = warm["ok"] + kill["ok"] + recov["ok"]
+    report["total_sessions"] = total
+    report["ok_sessions"] = ok
+    report["success_rate"] = ok / total if total else 0.0
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=120,
+                    help="sessions per phase")
+    ap.add_argument("--payload", type=int, default=4096)
+    ap.add_argument("--eject-base", type=float, default=0.5,
+                    help="eject backoff base seconds (test-sized)")
+    ap.add_argument("--drain-s", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    report = run(clients=args.clients, requests=args.requests,
+                 payload_len=args.payload, eject_base_s=args.eject_base,
+                 drain_s=args.drain_s,
+                 log=lambda m: print(f"[chaos] {m}", file=sys.stderr))
+    print(json.dumps(report, indent=2, default=str))
+    floor_ok = report["success_rate"] >= 0.99
+    print(f"[chaos] success rate {report['success_rate']:.4f} "
+          f"({'PASS' if floor_ok else 'FAIL'} at 0.99 floor)",
+          file=sys.stderr)
+    return 0 if floor_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

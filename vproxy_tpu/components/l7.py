@@ -26,13 +26,16 @@ HIGH_WATER = 1 * 1024 * 1024
 
 class _Sel:
     """Opaque backend selection handed back to the session; key identifies
-    the concrete backend server so sessions can pool/reuse connections."""
+    the concrete backend server so sessions can pool/reuse connections.
+    The hint that produced the selection rides along: connect retries
+    must re-run the SAME classify, not the global WRR."""
 
-    __slots__ = ("connector", "key")
+    __slots__ = ("connector", "key", "hint")
 
-    def __init__(self, connector):
+    def __init__(self, connector, hint=None):
         self.connector = connector
         self.key = (connector.ip, connector.port)
+        self.hint = hint
 
 
 class L7Engine(ProcessorEngine):
@@ -46,18 +49,20 @@ class L7Engine(ProcessorEngine):
         self.client_ip = parse_ip(ip)
         self.closed = False
         self.backs: dict[int, Connection] = {}
-        self.back_svrs: dict[int, object] = {}
+        self.back_sels: dict[int, object] = {}   # conn_id -> Connector
+        self._tried: dict[int, set] = {}         # conn_id -> retried svrs
+        self._hints: dict[int, object] = {}      # conn_id -> selection hint
         self._ids = itertools.count(1)
         self._front_paused = False
         self._back_paused: set[int] = set()
-        lb.active_sessions += 1
+        lb._sessions_delta(1)
         if front is not None:
             self.front = front
         else:
             try:
                 self.front = Connection(loop, cfd, (ip, port))
             except BaseException:
-                lb.active_sessions -= 1
+                lb._sessions_delta(-1)
                 from ..net import vtl
                 vtl.close(cfd)
                 raise
@@ -76,22 +81,93 @@ class L7Engine(ProcessorEngine):
         c = self.lb.backend.next(self.client_ip, hint)
         if c is None:
             raise OSError("no healthy backend for hint")
-        return _Sel(c)
+        return _Sel(c, hint)
 
     def open(self, sel: _Sel) -> int:
         if self.closed:
             raise OSError("session closed")
         if len(self.backs) >= MAX_BACKENDS:
             raise OSError("too many backend connections")
-        conn = Connection.connect(self.loop, sel.connector.ip,
-                                  sel.connector.port)
+        tried: set = set()
+        connector = sel.connector
+        while True:
+            try:
+                conn = Connection.connect(
+                    self.loop, connector.ip, connector.port,
+                    timeout_ms=self.lb.connect_timeout_ms)
+                break
+            except OSError as e:
+                # sync connect failure: report and re-enter selection
+                # excluding everything tried (shared retry knobs/budget)
+                tried.add(connector.svr)
+                connector.group.report_failure(connector.svr,
+                                               e.errno or 0)
+                connector = self._next_retry(tried, sel.hint)
+                if connector is None:
+                    raise OSError("backend connect failed "
+                                  "(retries exhausted)")
         conn_id = next(self._ids)
         self.backs[conn_id] = conn
-        svr = sel.connector.svr
-        self.back_svrs[conn_id] = svr
-        svr.conn_count += 1
+        self.back_sels[conn_id] = connector
+        self._tried[conn_id] = tried
+        self._hints[conn_id] = sel.hint
+        connector.svr.conn_count += 1
         conn.set_handler(_BackHandler(self, conn_id))
         return conn_id
+
+    def _next_retry(self, tried: set, hint):
+        """One retry-gated re-selection through the shared TcpLB gate,
+        re-running the SAME hint classify select() ran (hint group
+        first, then the initial pick's own WRR fallback); None when out
+        of attempts."""
+        lb = self.lb
+        return lb._take_retry_slot(
+            tried, "l7",
+            lambda: lb.backend.next_host(self.client_ip, hint,
+                                         exclude=tried))
+
+    def _reconnect_back(self, conn_id: int, dead: Connection,
+                        err: int = 0) -> bool:
+        """A backend conn died before completing its connect: swap in a
+        fresh connection to another backend under the SAME conn_id,
+        carrying over any bytes the session already wrote (still sitting
+        in the dead conn's out buffer — nothing reached the wire).
+        Transparent to the ProtoSession. True when the swap happened."""
+        if self.closed:
+            return False
+        tried = self._tried.setdefault(conn_id, set())
+        hint = self._hints.get(conn_id)
+        connector = self.back_sels.get(conn_id)
+        if connector is not None:
+            tried.add(connector.svr)
+            connector.group.report_failure(connector.svr,
+                                           -err if err < 0 else err)
+        pending = bytes(dead.out)
+        while True:
+            nxt = self._next_retry(tried, hint)
+            if nxt is None:
+                return False
+            try:
+                newc = Connection.connect(
+                    self.loop, nxt.ip, nxt.port,
+                    timeout_ms=self.lb.connect_timeout_ms)
+                break
+            except OSError as e:
+                tried.add(nxt.svr)
+                nxt.group.report_failure(nxt.svr, e.errno or 0)
+        self._release_back(conn_id, dead)  # pops the tried/hint state too
+        self.backs[conn_id] = newc
+        self.back_sels[conn_id] = nxt
+        self._tried[conn_id] = tried
+        self._hints[conn_id] = hint
+        nxt.svr.conn_count += 1
+        # handler FIRST: write() can close synchronously (late async
+        # connect refusal, out-buffer blowout) and that close must reach
+        # _BackHandler, not the default no-op Handler
+        newc.set_handler(_BackHandler(self, conn_id))
+        if pending:
+            newc.write(pending)
+        return True
 
     def send_front(self, data: bytes) -> None:
         if not self.closed:
@@ -116,7 +192,7 @@ class L7Engine(ProcessorEngine):
         if self.closed:
             return
         self.closed = True
-        self.lb.active_sessions -= 1
+        self.lb._sessions_delta(-1)
         self.lb.bytes_in += self.front.bytes_in
         self.lb.bytes_out += self.front.bytes_out
         self.front.set_handler(Handler())
@@ -150,8 +226,11 @@ class L7Engine(ProcessorEngine):
     # ----------------------------------------------------------- internals
 
     def _release_back(self, conn_id: int, conn: Connection) -> None:
-        svr = self.back_svrs.pop(conn_id, None)
-        if svr is not None:
+        sel = self.back_sels.pop(conn_id, None)
+        self._tried.pop(conn_id, None)
+        self._hints.pop(conn_id, None)
+        if sel is not None:
+            svr = sel.svr
             svr.conn_count -= 1
             svr.bytes_in += conn.bytes_out  # bytes we pushed toward the server
             svr.bytes_out += conn.bytes_in
@@ -203,9 +282,17 @@ class _BackHandler(Handler):
     def __init__(self, eng: L7Engine, conn_id: int):
         self.eng = eng
         self.conn_id = conn_id
+        self.connected = False
 
     def on_connected(self, conn: Connection) -> None:
-        self.eng.session.on_back_connected(self.conn_id)
+        self.connected = True
+        eng = self.eng
+        connector = eng.back_sels.get(self.conn_id)
+        if connector is not None:
+            connector.group.report_success(connector.svr)
+            if eng._tried.get(self.conn_id):  # a retry attempt landed
+                eng.lb._retries_total("success").incr()
+        eng.session.on_back_connected(self.conn_id)
 
     def on_data(self, conn: Connection, data: bytes) -> None:
         self.eng.session.on_back_data(self.conn_id, data)
@@ -215,6 +302,12 @@ class _BackHandler(Handler):
 
     def on_closed(self, conn: Connection, err: int) -> None:
         eng = self.eng
+        if not self.connected and not eng.closed \
+                and eng.backs.get(self.conn_id) is conn:
+            # pre-connect death: transparently swap in another backend
+            # (the session never learns; its written bytes carry over)
+            if eng._reconnect_back(self.conn_id, conn, err):
+                return
         conn2 = eng.backs.pop(self.conn_id, None)
         if conn2 is not None:
             eng._release_back(self.conn_id, conn2)

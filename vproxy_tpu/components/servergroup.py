@@ -11,15 +11,34 @@ edge thresholds (check/HealthCheckClient.java:100-137).
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import HintRule
+from ..utils import failpoint
 from .elgroup import EventLoopGroup
+
+# passive outlier ejection (report_failure): N consecutive data-plane
+# connect failures eject the backend immediately — detection latency is
+# one RTT instead of the health checker's interval*down (~seconds)
+EJECT_FAILURES = int(os.environ.get("VPROXY_TPU_EJECT_FAILURES", "3"))
+EJECT_BASE_S = float(os.environ.get("VPROXY_TPU_EJECT_BASE_S", "5"))
+EJECT_CAP_S = float(os.environ.get("VPROXY_TPU_EJECT_CAP_S", "300"))
+
+# proxy-local connect failures (fd/port/buffer exhaustion on OUR side):
+# not evidence against the backend — they must not feed its ejection
+# streak, or an overloaded proxy ejects its whole healthy pool
+import errno as _errno
+LOCAL_ERRNOS = frozenset({
+    _errno.EMFILE, _errno.ENFILE, _errno.EADDRNOTAVAIL,
+    _errno.EADDRINUSE, _errno.ENOBUFS, _errno.ENOMEM,
+})
 
 
 @dataclass
@@ -40,7 +59,7 @@ class HealthCheckConfig:
     dns_domain: str = "example.com"
 
 
-@dataclass
+@dataclass(eq=False)  # identity eq/hash: handles live in exclude-sets
 class ServerHandle:
     name: str
     ip: str
@@ -55,6 +74,11 @@ class ServerHandle:
     check_cost_ms: float = -1.0  # tcpDelay: last successful connect cost
     _up_cnt: int = 0
     _down_cnt: int = 0
+    # passive outlier-ejection state (ServerGroup.report_failure)
+    _consec_fails: int = 0       # consecutive data-plane connect failures
+    ejected: bool = False        # down via passive ejection (not hc edge)
+    _eject_backoff_s: float = 0.0  # last applied backoff (doubles per eject)
+    _eject_until: float = 0.0    # monotonic re-admission gate
 
     @property
     def is_v4(self) -> bool:
@@ -87,6 +111,11 @@ class _HealthChecker:
 
     def _check_once(self) -> None:
         if self.stopped:
+            return
+        if failpoint.hit("hc.force_down",
+                         f"{self.group.alias}/{self.svr.name} "
+                         f"{self.svr.ip}:{self.svr.port}"):
+            self._result(False)
             return
         cfg = self.group.hc
         if cfg.protocol == "http":
@@ -172,7 +201,11 @@ class _HealthChecker:
 
         def start() -> None:
             try:
-                c = Connection.connect(self.loop, self.svr.ip, self.svr.port)
+                # failpoints=False: the probe must not consume the data
+                # plane's count-armed backend.connect.* faults (probes
+                # have their own site, hc.force_down)
+                c = Connection.connect(self.loop, self.svr.ip,
+                                       self.svr.port, failpoints=False)
             except OSError:
                 finish(False)
                 return
@@ -211,7 +244,21 @@ class _HealthChecker:
             s._up_cnt += 1
             s._down_cnt = 0
             if not s.healthy and s._up_cnt >= cfg.up:
+                if s.ejected:
+                    # passively ejected: each passing active probe halves
+                    # the remaining backoff; the healthy flip waits for
+                    # the (shrinking) re-admission gate to expire
+                    now = time.monotonic()
+                    if now < s._eject_until:
+                        s._eject_until = now + (s._eject_until - now) / 2.0
+                        return
+                    self.group._readmit(s)
+                    return
                 s.healthy = True
+                # fresh UP edge starts a fresh ejection streak: stale
+                # pre-downtime failures must not let one post-recovery
+                # blip eject the server
+                s._consec_fails = 0
                 self.group._notify(s, True)
         else:
             s._down_cnt += 1
@@ -297,6 +344,11 @@ class ServerGroup:
                     s.ip = new_ip
                     was_healthy, s.healthy = s.healthy, False
                     s._up_cnt = s._down_cnt = 0
+                    # a new address is a new failure domain: drop any
+                    # passive-eject state along with the hc counters
+                    s.ejected = False
+                    s._consec_fails = 0
+                    s._eject_backoff_s = s._eject_until = 0.0
                     self._recalc()
                     # swap the checker under the lock: racing remove()
                     # must not resurrect a checker for a gone server
@@ -334,6 +386,85 @@ class ServerGroup:
                       group=self.alias, server=svr.name)
         for cb in self._listeners:
             cb(svr, up)
+
+    # ---------------------------------------- passive outlier ejection
+
+    def report_failure(self, svr: ServerHandle, err: int = 0) -> None:
+        """Data-plane connect failure/timeout against svr. N consecutive
+        failures ejects it immediately — the same DOWN edge the health
+        checker drives, but at one-RTT detection latency — with
+        exponential backoff re-admission (base EJECT_BASE_S, doubling to
+        EJECT_CAP_S; passing active probes halve the remaining wait).
+        `err` (errno, when the caller has it) filters out proxy-local
+        failures that say nothing about the backend."""
+        if err in LOCAL_ERRNOS:
+            return
+        from ..utils import events
+        eject = False
+        with self._lock:
+            svr._consec_fails += 1
+            if svr._consec_fails >= EJECT_FAILURES and svr.healthy:
+                # ejection floor: never empty the pool. With no other
+                # healthy backend, a possibly-flaky server beats a
+                # guaranteed full-group blackout (the hc still owns the
+                # hard-down edge for genuinely dead backends).
+                if not any(s.healthy and s.weight > 0 and s is not svr
+                           for s in self.servers):
+                    if svr._consec_fails == EJECT_FAILURES:
+                        events.record(
+                            "eject_skipped",
+                            f"{self.alias}/{svr.name} over the failure "
+                            "threshold but is the last healthy backend",
+                            group=self.alias, server=svr.name)
+                    return
+                svr.healthy = False
+                svr.ejected = True
+                svr._up_cnt = svr._down_cnt = 0
+                backoff = (EJECT_BASE_S if svr._eject_backoff_s <= 0
+                           else min(svr._eject_backoff_s * 2, EJECT_CAP_S))
+                svr._eject_backoff_s = backoff
+                svr._eject_until = time.monotonic() + backoff
+                eject = True
+        if eject:
+            self._eject_counter().incr()
+            events.record(
+                "eject", f"{self.alias}/{svr.name} {svr.ip}:{svr.port} "
+                f"EJECTED after {svr._consec_fails} connect failures, "
+                f"backoff {svr._eject_backoff_s:.0f}s",
+                group=self.alias, server=svr.name,
+                fails=svr._consec_fails, backoff_s=svr._eject_backoff_s)
+            self._notify(svr, False)
+
+    def report_success(self, svr: ServerHandle) -> None:
+        """Data-plane connect success against svr: clears the consecutive
+        failure streak and decays the eject backoff back to base so the
+        next ejection doesn't inherit a stale doubled penalty."""
+        with self._lock:
+            svr._consec_fails = 0
+            if not svr.ejected:
+                svr._eject_backoff_s = 0.0
+
+    def _readmit(self, svr: ServerHandle) -> None:
+        """Re-admission edge (health checker, backoff expired + up
+        threshold met): same UP notify path as an hc edge."""
+        from ..utils import events
+        with self._lock:
+            if not svr.ejected:
+                return
+            svr.ejected = False
+            svr.healthy = True
+            svr._consec_fails = 0
+            svr._eject_until = 0.0
+        events.record(
+            "readmit", f"{self.alias}/{svr.name} {svr.ip}:{svr.port} "
+            "re-admitted after eject backoff",
+            group=self.alias, server=svr.name)
+        self._notify(svr, True)
+
+    def _eject_counter(self):
+        from ..utils.metrics import GlobalInspection
+        return GlobalInspection.get().get_counter(
+            "vproxy_group_ejections_total", group=self.alias)
 
     def close(self) -> None:
         for chk in self._checkers.values():
@@ -387,14 +518,17 @@ class ServerGroup:
         return st
 
     def next(self, source_ip: Optional[bytes] = None,
-             fam: Optional[str] = None) -> Optional[Connector]:
+             fam: Optional[str] = None,
+             exclude: Optional[set] = None) -> Optional[Connector]:
+        """exclude: ServerHandles already tried this session (connect
+        retry must not re-dial the backend that just refused)."""
         if self.method == "wlc":
-            return self._wlc_next(fam)
+            return self._wlc_next(fam, exclude)
         if self.method == "source":
-            return self._source_next(source_ip or b"", fam)
-        return self._wrr_next(fam)
+            return self._source_next(source_ip or b"", fam, exclude)
+        return self._wrr_next(fam, exclude)
 
-    def _wrr_next(self, fam) -> Optional[Connector]:
+    def _wrr_next(self, fam, exclude=None) -> Optional[Connector]:
         with self._lock:
             st = self._wrr_state(fam)
             seq, servers = st["seq"], st["servers"]
@@ -404,13 +538,14 @@ class ServerGroup:
                 idx = st["cursor"] % len(seq)
                 st["cursor"] = idx + 1
                 s = servers[seq[idx]]
-                if s.healthy:
+                if s.healthy and not (exclude and s in exclude):
                     return Connector(s, self)
             return None
 
-    def _wlc_next(self, fam) -> Optional[Connector]:
+    def _wlc_next(self, fam, exclude=None) -> Optional[Connector]:
         with self._lock:
-            servers = [s for s in self._subset(fam) if s.healthy]
+            servers = [s for s in self._subset(fam)
+                       if s.healthy and not (exclude and s in exclude)]
             if not servers:
                 return None
             m = servers[0]
@@ -431,7 +566,8 @@ class ServerGroup:
                 h = 0
         return h
 
-    def _source_next(self, source_ip: bytes, fam) -> Optional[Connector]:
+    def _source_next(self, source_ip: bytes, fam,
+                     exclude=None) -> Optional[Connector]:
         with self._lock:
             servers = self._subset(fam)
             if not servers:
@@ -439,7 +575,7 @@ class ServerGroup:
             idx = self._sdbm(source_ip) % len(servers)
             for _ in range(len(servers)):
                 s = servers[idx % len(servers)]
-                if s.healthy:
+                if s.healthy and not (exclude and s in exclude):
                     return Connector(s, self)
                 idx += 1
             return None

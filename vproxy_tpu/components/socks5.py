@@ -166,6 +166,12 @@ class _Socks5Session(Handler):
         del self.buf[:need]
         self.state = self.ST_DONE
 
+        # retries re-run THIS selection (hint-only seek) minus tried —
+        # a CONNECT to db.example:5432 must never fail over to a backend
+        # of some other service
+        hint = (Hint.of_host_port(addr, port) if atyp == ATYP_DOMAIN
+                else None)
+
         def picked(connector, direct) -> None:
             if conn.closed:
                 return
@@ -173,7 +179,7 @@ class _Socks5Session(Handler):
                 self._reply(conn, REP_NOT_ALLOWED)
                 return
             target = (connector.ip, connector.port) if connector else direct
-            self._connect_and_splice(conn, connector, target)
+            self._connect_and_splice(conn, connector, target, set(), hint)
 
         self.server.pick_target_async(
             parse_ip(self.client_ip), atyp, addr, port, picked, self.loop)
@@ -183,18 +189,20 @@ class _Socks5Session(Handler):
         if rep != REP_OK:
             self.loop.delay(20, conn.close)
 
-    def _connect_and_splice(self, conn: Connection, connector, target) -> None:
+    def _connect_and_splice(self, conn: Connection, connector, target,
+                            tried=None, hint=None) -> None:
         svr = connector.svr if connector else None
         if svr is not None:
             svr.conn_count += 1
-        self.server.active_sessions += 1
+        self.server._sessions_delta(1)
         # stop pulling client bytes into python: whatever is already in
         # session.buf is flushed to the backend at handover; everything
         # later stays in the kernel buffer for the pump
         conn.pause_reading()
         host, port = target
         if is_ip_literal(host):
-            self._do_connect(conn, svr, host, port, self._mk_release(svr))
+            self._do_connect(conn, svr, host, port, self._mk_release(svr),
+                             connector=connector, tried=tried, hint=hint)
             return
         # direct (allow_non_backend) domain target: resolve off-loop, then
         # continue on the loop (Socks5Server.java resolves via Resolver)
@@ -230,22 +238,65 @@ class _Socks5Session(Handler):
             released[0] = True
             if svr is not None:
                 svr.conn_count -= 1
-            lb.active_sessions -= 1
+            lb._sessions_delta(-1)
         return release
 
+    def _retry_backend(self, conn: Connection, tried: set, hint) -> bool:
+        """Pre-reply backend connect failed: re-run the ORIGINAL
+        hint-only selection (never the global WRR — the client named a
+        target) minus tried, under the shared TcpLB retry gate. Literal
+        ip:port targets have no hint and therefore no alternatives; they
+        don't retry. True when a new attempt owns the session."""
+        lb = self.server
+        if conn.closed or conn.detached or hint is None:
+            return False
+        src_ip = parse_ip(self.client_ip)
+        c = lb._take_retry_slot(
+            tried, f"socks5 {self.client_ip}",
+            lambda: lb.backend.seek_host(src_ip, hint, exclude=tried))
+        if c is None:
+            return False
+        self._connect_and_splice(conn, c, (c.ip, c.port), tried, hint)
+        return True
+
     def _do_connect(self, conn: Connection, svr, ip: str, port: int,
-                    release) -> None:
+                    release, connector=None, tried=None,
+                    hint=None) -> None:
         lb = self.server
         session = self
+        group = connector.group if connector is not None else None
         try:
-            back = Connection.connect(self.loop, ip, port)
-        except OSError:
+            # bounded connect for BACKEND targets only: a SYN blackhole
+            # times out into the same on_closed retry path a refusal
+            # takes. Direct (allow-non-backend) targets are arbitrary
+            # internet hosts with no retry alternative — they keep the
+            # kernel's own connect deadline.
+            back = Connection.connect(
+                self.loop, ip, port,
+                timeout_ms=(lb.connect_timeout_ms
+                            if connector is not None else 0))
+        except OSError as e:
+            retried = False
+            if group is not None and tried is not None:
+                tried.add(svr)
+                group.report_failure(svr, e.errno or 0)
+                retried = self._retry_backend(conn, tried, hint)
+            # release AFTER the retry decision: the new attempt's
+            # increment keeps active_sessions from dipping to 0, which
+            # drain_wait would misread as "drained"
             release()
-            self._reply(conn, REP_HOST_UNREACH)
+            if not retried:
+                self._reply(conn, REP_HOST_UNREACH)
             return
-
         class Back(Handler):
+            connected = False
+
             def on_connected(self, bconn: Connection) -> None:
+                self.connected = True
+                if group is not None:
+                    group.report_success(svr)
+                    if tried:  # a retry attempt landed
+                        lb._retries_total("success").incr()
                 # keep early backend bytes in the kernel buffer for the pump
                 bconn.pause_reading()
                 session._reply(conn, REP_OK)
@@ -291,9 +342,19 @@ class _Socks5Session(Handler):
                 release()
 
             def on_closed(self, bconn: Connection, err: int) -> None:
-                release()
-                if not conn.closed and not conn.detached:
-                    session._reply(conn, REP_HOST_UNREACH)
+                retried = False
+                if not (conn.closed or conn.detached) \
+                        and not self.connected and group is not None \
+                        and tried is not None:
+                    # nonblocking connect failed asynchronously: same
+                    # retry re-entry as the sync raise above
+                    tried.add(svr)
+                    group.report_failure(svr, -err if err < 0 else err)
+                    retried = session._retry_backend(conn, tried, hint)
+                release()  # after the retry decision: no count dip
+                if retried or conn.closed or conn.detached:
+                    return
+                session._reply(conn, REP_HOST_UNREACH)
 
         back.set_handler(Back())
 

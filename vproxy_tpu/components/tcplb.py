@@ -15,6 +15,8 @@ full per-request/per-stream L7 engine (components/l7.py).
 """
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Optional
 
@@ -23,7 +25,7 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
-from ..utils import events
+from ..utils import events, failpoint
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
 from ..utils.metrics import accept_stage_observe
@@ -35,6 +37,62 @@ from .upstream import Upstream
 
 _log = Logger("tcp-lb")
 
+# failure-containment knobs (docs/robustness.md)
+CONNECT_RETRIES = int(os.environ.get("VPROXY_TPU_CONNECT_RETRIES", "2"))
+RETRY_BUDGET_RATIO = float(os.environ.get("VPROXY_TPU_RETRY_BUDGET", "0.2"))
+MAX_SESSIONS = int(os.environ.get("VPROXY_TPU_MAX_SESSIONS", "1000000"))
+CONNECT_TIMEOUT_MS = int(os.environ.get("VPROXY_TPU_CONNECT_TIMEOUT_MS",
+                                        "3000"))
+
+
+class RetryBudget:
+    """Sliding-window retry budget: retries ≤ ratio × accepts (+ a small
+    burst floor so a quiet LB's first failure can still fail over). A
+    dead cluster must not double its own connect load via retries, so
+    the budget is enforced per LB over a two-bucket rolling window."""
+
+    __slots__ = ("ratio", "burst", "window_s", "_lock",
+                 "_t0", "_accepts", "_retries", "_p_accepts", "_p_retries")
+
+    def __init__(self, ratio: float = RETRY_BUDGET_RATIO, burst: int = 5,
+                 window_s: float = 10.0):
+        self.ratio = ratio
+        self.burst = burst
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._accepts = 0
+        self._retries = 0
+        self._p_accepts = 0  # previous bucket (smooths the window edge)
+        self._p_retries = 0
+
+    def _roll(self, now: float) -> None:
+        age = now - self._t0
+        if age < self.window_s:
+            return
+        if age < 2 * self.window_s:
+            self._p_accepts, self._p_retries = self._accepts, self._retries
+        else:
+            self._p_accepts = self._p_retries = 0
+        self._accepts = self._retries = 0
+        self._t0 = now
+
+    def on_accept(self) -> None:
+        with self._lock:
+            self._roll(time.monotonic())
+            self._accepts += 1
+
+    def try_take(self) -> bool:
+        """Reserve one retry; False when the budget is exhausted."""
+        with self._lock:
+            self._roll(time.monotonic())
+            accepts = self._accepts + self._p_accepts
+            retries = self._retries + self._p_retries
+            if retries + 1 > self.ratio * accepts + self.burst:
+                return False
+            self._retries += 1
+            return True
+
 
 class _SpliceBack(Handler):
     """Backend-connect handler for the splice path — ONE shared class
@@ -42,11 +100,13 @@ class _SpliceBack(Handler):
     short-connection profile)."""
 
     __slots__ = ("lb", "loop", "front_fd", "target", "head", "front",
-                 "_pid", "tls_ctx", "t_acc", "t_back")
+                 "_pid", "tls_ctx", "t_acc", "t_back", "connected",
+                 "src_ip", "tried", "hint")
 
     def __init__(self, lb, loop, front_fd: int, target: Connector,
                  head: bytes, front: str, tls_ctx: int = 0,
-                 t_acc: Optional[float] = None):
+                 t_acc: Optional[float] = None, src_ip: bytes = b"",
+                 tried: Optional[set] = None, hint=None):
         self.lb = lb
         self.loop = loop
         self.front_fd = front_fd
@@ -57,8 +117,17 @@ class _SpliceBack(Handler):
         self.tls_ctx = tls_ctx  # nonzero: TLS-terminating pump
         self.t_acc = t_acc         # accept timestamp (span timers)
         self.t_back = time.monotonic()  # backend chosen -> handover span
+        self.connected = False     # flips in on_connected: phase evidence
+        self.src_ip = src_ip       # client addr bytes (retry re-balance)
+        self.tried = tried if tried is not None else set()
+        self.hint = hint           # classify hint: retries re-run the
+                                   # original selection, not plain WRR
 
     def on_connected(self, conn: Connection) -> None:
+        self.connected = True
+        self.target.group.report_success(self.target.svr)
+        if self.tried:  # a retry attempt landed
+            self.lb._retries_total("success").incr()
         # do NOT consume early backend bytes (100-continue, early
         # errors): leave them queued in the kernel for the pump
         conn.pause_reading()
@@ -104,18 +173,34 @@ class _SpliceBack(Handler):
         svr.bytes_in += a2b
         svr.bytes_out += b2a
         svr.conn_count -= 1
-        lb.active_sessions -= 1
+        lb._sessions_delta(-1)
         events.record(
             "conn", f"{self.front} -> {self.target.ip}:{self.target.port} "
             "closed", lb=lb.alias, bytes_in=a2b, bytes_out=b2a, err=err)
 
     def on_closed(self, conn: Connection, err: int) -> None:
         self.target.svr.conn_count -= 1
-        self.lb.active_sessions -= 1
+        errno_ = -err if err < 0 else err  # close(-err) carries the errno
+        if not self.connected:
+            # backend refused/unreachable pre-handshake: the retry layer
+            # owns the front fd from here (closes it if no retry starts).
+            # This attempt's session count is released AFTER the retry
+            # decision so a mid-retry drain_wait never sees a false zero.
+            self.lb._backend_connect_failed(
+                self.loop, self.front_fd, self.target, self.head,
+                self.front, self.t_acc, self.src_ip, self.tls_ctx,
+                self.tried, errno_, hint=self.hint)
+            self.lb._sessions_delta(-1)
+            return
+        self.lb._sessions_delta(-1)
+        # the backend connected and then died before pump handover — a
+        # different failure domain than a refused connect, and the event
+        # must say so (it used to claim "backend connect failed" here)
         vtl.close(self.front_fd)
         events.record(
             "conn", f"{self.front} -> {self.target.ip}:{self.target.port} "
-            "backend connect failed", lb=self.lb.alias, err=err)
+            "backend closed before handover", lb=self.lb.alias, err=errno_,
+            phase="pre_handover_close")
 
 
 class TcpLB:
@@ -124,7 +209,8 @@ class TcpLB:
                  backend: Upstream, protocol: str = "tcp",
                  security_group: Optional[SecurityGroup] = None,
                  in_buffer_size: int = 65536, timeout_ms: int = 900_000,
-                 cert_keys: Optional[list] = None):
+                 cert_keys: Optional[list] = None,
+                 max_sessions: int = 0):
         if protocol not in ("tcp", "http-splice") \
                 and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
@@ -144,6 +230,19 @@ class TcpLB:
         self.timeout_ms = timeout_ms
         self.server_socks: list[ServerSock] = []
         self.started = False
+        # failure containment: bounded connect retries under a per-LB
+        # budget, accept shedding past max_sessions, graceful drain
+        self.max_sessions = max_sessions if max_sessions > 0 else MAX_SESSIONS
+        self.connect_retries = CONNECT_RETRIES
+        self.connect_timeout_ms = CONNECT_TIMEOUT_MS
+        self.draining = False
+        # sessions mutate from every worker loop and the counter now
+        # gates behavior (overload shed, drain completion): the +=/-=
+        # must not lose updates to GIL interleaving
+        self._sess_lock = threading.Lock()
+        self._retry_budget = RetryBudget()
+        self._retry_ctrs: dict[str, object] = {}
+        self._overload_ctr = None
         # stats (cmd/ResourceType accepted-conn-count / bytes-in / bytes-out)
         self.accepted = 0
         self.active_sessions = 0
@@ -162,7 +261,7 @@ class TcpLB:
         """LBAttach semantics (TcpLB.java:45-66): an acceptor loop died —
         forget its listener (the dying loop already closed the fd) and
         bind a replacement on a surviving loop so capacity recovers."""
-        if group is not self.acceptor or not self.started:
+        if group is not self.acceptor or not self.started or self.draining:
             return
         dead = [ss for ss in self.server_socks if ss.loop is lp]
         if not dead:
@@ -225,10 +324,131 @@ class TcpLB:
             ss.loop.run_on_loop(ss.close)
         self.server_socks = []
 
+    def begin_drain(self) -> None:
+        """Graceful drain: close the listeners so no new connections
+        arrive (upstream LBs see RSTs / healthz says draining and steer
+        away) while live pumps run to completion. Raced-in accepts are
+        shed in _on_accept. Idempotent; stop() still tears down fully."""
+        if self.draining:
+            return
+        self.draining = True
+        events.record("drain",
+                      f"lb {self.alias} draining: listeners closing, "
+                      f"{self.active_sessions} sessions in flight",
+                      lb=self.alias, sessions=self.active_sessions)
+        if self.started:
+            for ss in self.server_socks:
+                ss.loop.run_on_loop(ss.close)
+            self.server_socks = []
+
+    # ------------------------------------------------- failure containment
+
+    def _sessions_delta(self, d: int) -> None:
+        with self._sess_lock:
+            self.active_sessions += d
+
+    def _retries_total(self, result: str):
+        c = self._retry_ctrs.get(result)
+        if c is None:
+            from ..utils.metrics import GlobalInspection
+            c = self._retry_ctrs[result] = GlobalInspection.get().get_counter(
+                "vproxy_lb_retries_total", lb=self.alias, result=result)
+        return c
+
+    def _overload_total(self):
+        if self._overload_ctr is None:
+            from ..utils.metrics import GlobalInspection
+            self._overload_ctr = GlobalInspection.get().get_counter(
+                "vproxy_lb_overload_total", lb=self.alias)
+        return self._overload_ctr
+
+    def _take_retry_slot(self, tried: set, what: str, pick):
+        """THE retry gate, shared by the splice/TLS path, Socks5 and the
+        L7 engine: attempt cap -> budget -> re-selection via `pick()`
+        (a callable returning Connector | None — callers bind their own
+        selection semantics, e.g. hint-seek vs WRR). Returns the next
+        Connector or None; every outcome lands in
+        vproxy_lb_retries_total{result=} and the flight recorder.
+        Retries stay allowed while draining: an accepted connection IS
+        in-flight work the drain contract protects."""
+        if not self.started:
+            return None
+        if len(tried) > self.connect_retries:
+            self._retries_total("exhausted").incr()
+            events.record("retry",
+                          f"{what}: retries exhausted after "
+                          f"{len(tried)} attempts",
+                          lb=self.alias, result="exhausted")
+            return None
+        target = pick()
+        if target is None:
+            # selection BEFORE the budget take: a no-alternative outcome
+            # generates zero connect load and must not burn the budget
+            # other sessions need for real retries
+            self._retries_total("no_backend").incr()
+            events.record("retry", f"{what}: no alternative backend",
+                          lb=self.alias, result="no_backend")
+            return None
+        if not self._retry_budget.try_take():
+            self._retries_total("budget_exhausted").incr()
+            events.record("retry", f"{what}: retry budget exhausted",
+                          lb=self.alias, result="budget_exhausted")
+            return None
+        events.record("retry",
+                      f"{what} retry {len(tried)} -> "
+                      f"{target.ip}:{target.port}",
+                      lb=self.alias, attempt=len(tried))
+        return target
+
+    def _backend_connect_failed(self, loop, front_fd: int, target: Connector,
+                                head: bytes, front: str,
+                                t_acc: Optional[float], src_ip: bytes,
+                                tls_ctx: int, tried: set, err: int,
+                                hint=None) -> None:
+        """A pre-handover backend connect failed (sync raise or async
+        finish_connect error). Owns front_fd: either a retry attempt
+        takes it over or it is closed here. Session counters for the
+        failed attempt are already released by the caller. The retry
+        re-runs the ORIGINAL selection semantics (hint group first, then
+        the same WRR fallback the initial classify uses when the hint
+        group is empty) minus the tried set — a retry is never MORE
+        willing to leave the hint group than the first pick was."""
+        svr = target.svr
+        tried.add(svr)
+        events.record(
+            "conn", f"{front} -> {target.ip}:{target.port} connect failed",
+            lb=self.alias, err=err, phase="connect_failed",
+            attempt=len(tried))
+        target.group.report_failure(svr, err)
+        nxt = self._take_retry_slot(
+            tried, front,
+            lambda: self.backend.next_host(src_ip, hint, exclude=tried))
+        if nxt is None:
+            vtl.close(front_fd)
+            return
+        self._splice(loop, front_fd, nxt, head, front, t_acc,
+                     src_ip=src_ip, tls_ctx=tls_ctx, tried=tried, hint=hint)
+
     # --------------------------------------------------------- data plane
 
     def _on_accept(self, loop, cfd: int, ip: str, port: int) -> None:
+        if self.draining:
+            # listener close raced an in-flight accept: shed it; the
+            # drain contract only protects established sessions
+            vtl.close(cfd)
+            events.record("drain_shed", f"{ip}:{port} shed: draining",
+                          lb=self.alias)
+            return
+        if self.active_sessions >= self.max_sessions:
+            # overload guard: close-on-accept beats queueing unboundedly
+            self._overload_total().incr()
+            vtl.close(cfd)
+            events.record(
+                "overload", f"{ip}:{port} shed: {self.active_sessions} "
+                f"sessions at max {self.max_sessions}", lb=self.alias)
+            return
         self.accepted += 1
+        self._retry_budget.on_accept()
         t_acc = time.monotonic()
 
         # ACL gate (SecurityGroup.allow — TcpLB.java:168-171); the lookup
@@ -268,13 +488,14 @@ class TcpLB:
             self._serve_tls(loop, cfd, ip, port, t_acc)
         elif self.protocol == "tcp":
             t0 = time.monotonic()
-            conn = self.backend.next(parse_ip(ip))
+            src_ip = parse_ip(ip)
+            conn = self.backend.next(src_ip)
             accept_stage_observe("backend_pick", time.monotonic() - t0)
             if conn is None:
                 vtl.close(cfd)
                 return
             self._splice(loop, cfd, conn, b"", front=f"{ip}:{port}",
-                         t_acc=t_acc)
+                         t_acc=t_acc, src_ip=src_ip)
         elif self.protocol == "http-splice":
             self._http_classify(loop, cfd, ip, port, t_acc)
         else:
@@ -390,14 +611,17 @@ class TcpLB:
                 return
             hint = Hint.of_host(sni) if sni else None
 
+            src_ip = parse_ip(ip)
+
             def on_back(back) -> None:
                 if back is None:
                     vtl.close(cfd)
                     return
                 self._splice_tls(loop, cfd, back, ctx,
-                                 front=f"{ip}:{port}", t_acc=t_acc)
+                                 front=f"{ip}:{port}", t_acc=t_acc,
+                                 src_ip=src_ip, hint=hint)
 
-            lb.backend.next_async(parse_ip(ip), hint, on_back, loop=loop)
+            lb.backend.next_async(src_ip, hint, on_back, loop=loop)
 
         try:
             loop.add(cfd, vtl.EV_READ, on_ev)
@@ -439,22 +663,12 @@ class TcpLB:
 
     def _splice_tls(self, loop, front_fd: int, target: Connector,
                     ctx: int, front: str = "?",
-                    t_acc: Optional[float] = None) -> None:
+                    t_acc: Optional[float] = None,
+                    src_ip: bytes = b"", hint=None) -> None:
         """Like _splice, but the handover runs the TLS-terminating pump
         (client side TLS in C, backend plaintext)."""
-        svr = target.svr
-        svr.conn_count += 1
-        self.active_sessions += 1
-        try:
-            back = Connection.connect(loop, target.ip, target.port)
-        except OSError:
-            svr.conn_count -= 1
-            self.active_sessions -= 1
-            vtl.close(front_fd)
-            return
-        back.set_handler(_SpliceBack(self, loop, front_fd, target, b"",
-                                     f"tls {front}", tls_ctx=ctx,
-                                     t_acc=t_acc))
+        self._splice(loop, front_fd, target, b"", f"tls {front}",
+                     t_acc=t_acc, src_ip=src_ip, tls_ctx=ctx, hint=hint)
 
     # ------------------------------------------------------ idle timeout
 
@@ -495,6 +709,10 @@ class TcpLB:
         st = self._pump_watch.setdefault(id(loop), {})
         self._watch_loops[id(loop)] = loop  # session listing needs the obj
         st[pid] = (0, loop.now, desc)
+        if failpoint.hit("pump.abort", desc):
+            # kill the just-registered pump on the owning loop; the DONE
+            # callback runs the normal cleanup path
+            loop.next_tick(lambda: loop.pump_close(pid))
         if len(st) == 1:
             self._arm_sweep(loop)
 
@@ -569,7 +787,8 @@ class TcpLB:
                         buffered = bytes(parser.buf)
                         ffd = conn.detach()
                         lb._splice(loop, ffd, back, buffered,
-                                   front=f"{ip}:{port}", t_acc=t_acc)
+                                   front=f"{ip}:{port}", t_acc=t_acc,
+                                   src_ip=parse_ip(ip), hint=hint)
 
                     lb.backend.next_async(parse_ip(ip), hint, on_back,
                                           loop=loop)
@@ -581,16 +800,28 @@ class TcpLB:
 
     def _splice(self, loop, front_fd: int, target: Connector,
                 head: bytes, front: str = "?",
-                t_acc: Optional[float] = None) -> None:
+                t_acc: Optional[float] = None, src_ip: bytes = b"",
+                tls_ctx: int = 0, tried: Optional[set] = None,
+                hint=None) -> None:
+        if tried is None:
+            tried = set()
         svr = target.svr
         svr.conn_count += 1
-        self.active_sessions += 1
+        self._sessions_delta(1)
         try:
-            back = Connection.connect(loop, target.ip, target.port)
-        except OSError:
+            # the timeout turns a SYN-blackholed backend into the same
+            # on_closed(-ETIMEDOUT) -> retry path a refusal takes
+            back = Connection.connect(loop, target.ip, target.port,
+                                      timeout_ms=self.connect_timeout_ms)
+        except OSError as e:
             svr.conn_count -= 1
-            self.active_sessions -= 1
-            vtl.close(front_fd)
+            # retry first, release after: active_sessions must not dip
+            # to 0 mid-retry (drain_wait reads it as "drained")
+            self._backend_connect_failed(loop, front_fd, target, head,
+                                         front, t_acc, src_ip, tls_ctx,
+                                         tried, e.errno or 1, hint=hint)
+            self._sessions_delta(-1)
             return
         back.set_handler(_SpliceBack(self, loop, front_fd, target, head,
-                                     front, t_acc=t_acc))
+                                     front, tls_ctx=tls_ctx, t_acc=t_acc,
+                                     src_ip=src_ip, tried=tried, hint=hint))

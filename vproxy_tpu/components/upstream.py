@@ -100,21 +100,64 @@ class Upstream:
                 for i in self._matcher.match(hints)]
 
     def seek(self, source_ip: bytes, hint: Hint,
-             fam: Optional[str] = None) -> Optional[Connector]:
+             fam: Optional[str] = None,
+             exclude: Optional[set] = None) -> Optional[Connector]:
         h = self.search_for_group(hint)
         if h is not None:
-            return h.group.next(source_ip, fam)
+            return h.group.next(source_ip, fam, exclude)
+        return None
+
+    # --------------------------------------- host-only (retry) selection
+
+    def _search_host(self, hint: Hint) -> Optional[GroupHandle]:
+        """search_for_group on the HOST index only (exact oracle parity,
+        O(probes), ~µs — rules/index.py): the connect-retry path runs
+        inside event-loop failure callbacks and must never eat a
+        synchronous device dispatch, least of all during a backend
+        outage when retries spike."""
+        m = self._matcher
+        snap = m.snapshot()
+        idx = m.index_snap(snap, hint)
+        payload = m.snap_payload(snap)
+        handles = payload if payload is not None else self.handles
+        return handles[idx] if 0 <= idx < len(handles) else None
+
+    def next_host(self, source_ip: bytes, hint: Optional[Hint] = None,
+                  fam: Optional[str] = None,
+                  exclude: Optional[set] = None) -> Optional[Connector]:
+        """`next` semantics (hint group first, WRR fallback) with the
+        classify served from the host index."""
+        if hint is not None:
+            h = self._search_host(hint)
+            if h is not None:
+                c = h.group.next(source_ip, fam, exclude)
+                if c is not None:
+                    return c
+        return self._wrr_next(source_ip, fam, exclude)
+
+    def seek_host(self, source_ip: bytes, hint: Hint,
+                  fam: Optional[str] = None,
+                  exclude: Optional[set] = None) -> Optional[Connector]:
+        """`seek` semantics (hint-only, no WRR fallback), host index."""
+        h = self._search_host(hint)
+        if h is not None:
+            return h.group.next(source_ip, fam, exclude)
         return None
 
     def next(self, source_ip: bytes, hint: Optional[Hint] = None,
-             fam: Optional[str] = None) -> Optional[Connector]:
+             fam: Optional[str] = None,
+             exclude: Optional[set] = None) -> Optional[Connector]:
+        """exclude: ServerHandles a connect-retry must skip (the
+        failure-containment layer re-enters this loop after a backend
+        refused, excluding everything already tried)."""
         if hint is not None:
-            c = self.seek(source_ip, hint, fam)
+            c = self.seek(source_ip, hint, fam, exclude)
             if c is not None:
                 return c
-        return self._wrr_next(source_ip, fam)
+        return self._wrr_next(source_ip, fam, exclude)
 
-    def _wrr_next(self, source_ip: bytes, fam: Optional[str]) -> Optional[Connector]:
+    def _wrr_next(self, source_ip: bytes, fam: Optional[str],
+                  exclude: Optional[set] = None) -> Optional[Connector]:
         with self._lock:
             seq, groups = self._wrr_seq, self._wrr_groups
             for _ in range(len(seq) + 1):
@@ -122,7 +165,7 @@ class Upstream:
                     return None
                 idx = self._wrr_cursor % len(seq)
                 self._wrr_cursor = idx + 1
-                c = groups[seq[idx]].group.next(source_ip, fam)
+                c = groups[seq[idx]].group.next(source_ip, fam, exclude)
                 if c is not None:
                     return c
             return None

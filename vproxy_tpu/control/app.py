@@ -45,6 +45,9 @@ class Application:
         # (switch alias, vni) -> {"ip:port": VpcProxy}
         self.vpc_proxies: dict[tuple, dict] = {}
         self._resolver = None  # lazy "(default)" resolver
+        # fired by request_drain (the `drain` command / SIGTERM path);
+        # main.py registers its stop event here
+        self.on_drain_request: list = []
 
         self.elgs[DEFAULT_CONTROL_ELG] = EventLoopGroup(DEFAULT_CONTROL_ELG, 1)
         worker = EventLoopGroup(DEFAULT_WORKER_ELG, workers)
@@ -83,6 +86,62 @@ class Application:
     @property
     def acceptor_elg(self) -> EventLoopGroup:
         return self.elgs[DEFAULT_ACCEPTOR_ELG]
+
+    # ------------------------------------------------------ graceful drain
+
+    def request_drain(self) -> str:
+        """Begin graceful drain (SIGTERM and the `drain` command funnel
+        here): flip /healthz to draining so upstream LBs steer away,
+        close every frontend listener (in-flight pumps keep running),
+        and fire the drain-request callbacks (main.py registers its
+        stop event there so the process exits after the drain window)."""
+        from ..utils import events, lifecycle
+        if not lifecycle.set_draining():
+            return "already draining"
+        total = sum(lb.active_sessions
+                    for lb in list(self.tcp_lbs.values())
+                    + list(self.socks5_servers.values()))
+        events.record("drain", f"drain requested: {total} sessions in "
+                      "flight, healthz now draining", sessions=total)
+        for lb in list(self.tcp_lbs.values()) \
+                + list(self.socks5_servers.values()):
+            lb.begin_drain()
+        for cb in list(self.on_drain_request):
+            cb()
+        return "OK"
+
+    def drain_wait(self, timeout_s: float, poll_s: float = 0.05,
+                   settle_s: float = 0.2) -> bool:
+        """Block (main thread only) until every LB session finishes or
+        the drain window closes; True when fully drained. Completion
+        requires the count to stay zero across a settle window:
+        active_sessions counts from backend-pick onward, so connections
+        still in their handshake/classify phase (socks5 greeting, TLS
+        peek, http head-parse) surface a moment later — an instant zero
+        must not be read as 'drained'."""
+        import time as _time
+        from ..utils import events
+        deadline = _time.monotonic() + timeout_s
+        zero_since = None
+        while True:
+            left = sum(lb.active_sessions
+                       for lb in list(self.tcp_lbs.values())
+                       + list(self.socks5_servers.values()))
+            now = _time.monotonic()
+            if left <= 0:
+                if zero_since is None:
+                    zero_since = now
+                elif now - zero_since >= settle_s:
+                    events.record("drain", "drain complete: all sessions "
+                                  "finished")
+                    return True
+            else:
+                zero_since = None
+            if now >= deadline:
+                events.record("drain", f"drain window closed with {left} "
+                              "sessions still in flight", sessions=left)
+                return left <= 0
+            _time.sleep(poll_s)
 
     @classmethod
     def create(cls, workers: Optional[int] = None) -> "Application":

@@ -68,6 +68,7 @@ TYPES = {
     "http-controller": "http-controller",
     "docker-network-plugin-controller": "docker-network-plugin-controller",
     "event-log": "event-log", "events": "event-log",
+    "fault": "fault", "failpoint": "fault",
 }
 
 PARAM_KEYS = {
@@ -92,6 +93,9 @@ PARAM_KEYS = {
     "mac-table-timeout": "mac-table-timeout",
     "arp-table-timeout": "arp-table-timeout",
     "path": "path", "post-script": "post-script",
+    "probability": "probability", "prob": "probability",
+    "count": "count", "match": "match",
+    "max-sessions": "max-sessions",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -176,6 +180,11 @@ class Command:
 
     @staticmethod
     def execute(app: Application, line: str):
+        if line.strip() == "drain":
+            # bare verb outside the resource grammar (like the repl's
+            # `exit`): begin graceful drain — close listeners, flip
+            # /healthz to draining, let pumps finish, then main exits
+            return app.request_drain()
         c = Command.parse(line)
         handler = _HANDLERS.get(c.type)
         if handler is None:
@@ -530,7 +539,9 @@ def _h_tl(app: Application, c: Command):
                    in_buffer_size=int(c.params.get("in-buffer-size", 16384)),
                    timeout_ms=(_pos_int(c, "timeout")
                                if "timeout" in c.params else 900_000),
-                   cert_keys=cks)
+                   cert_keys=cks,
+                   max_sessions=(_nonneg_int(c, "max-sessions")
+                                 if "max-sessions" in c.params else 0))
         lb.start()
         app.tcp_lbs[c.alias] = lb
         return "OK"
@@ -561,6 +572,11 @@ def _h_tl(app: Application, c: Command):
                 raise CmdError(f"cert swap failed (nothing changed): {e}")
         if new_timeout is not None:  # hot-settable (TcpLB.java:294-320)
             lb.set_timeout(new_timeout)
+        if "max-sessions" in c.params:  # hot-set the overload guard;
+            # 0 restores the default ceiling (same convention as add)
+            from ..components.tcplb import MAX_SESSIONS as _def_ms
+            ms = _nonneg_int(c, "max-sessions")
+            lb.max_sessions = ms if ms > 0 else _def_ms
         return "OK"
     if c.action in ("remove", "force-remove"):
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
@@ -959,6 +975,18 @@ def _h_ip(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for ip")
 
 
+def _nonneg_int(c: "Command", key: str, what: str = "") -> int:
+    """Non-negative integer param; 0 is meaningful (max-sessions 0 =
+    restore the default ceiling, on add and update alike)."""
+    try:
+        v = int(c.params[key])
+    except ValueError:
+        raise CmdError(f"bad {what or key}: {c.params[key]!r}")
+    if v < 0:
+        raise CmdError(f"{what or key} must be >= 0, got {v}")
+    return v
+
+
 def _pos_int(c: "Command", key: str, what: str = "") -> int:
     """Positive-integer param: `timeout 0` (or a seconds-vs-ms typo
     going negative) would turn idle sweeps into kill-everything loops."""
@@ -1116,6 +1144,36 @@ def _h_eventlog(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for event-log")
 
 
+def _h_fault(app: Application, c: Command):
+    """`add fault <site> [probability p] [count n] [match m]` arms a
+    named failpoint (utils/failpoint — the chaos-testing injection
+    sites); `remove fault <site>` disarms; `list fault` shows armed
+    faults with hit counts (same view as `GET /faults`)."""
+    from ..utils import failpoint
+    if c.action == "add":
+        try:
+            failpoint.arm(
+                c.alias,
+                probability=float(c.params.get("probability", "1.0")),
+                count=int(c.params["count"]) if "count" in c.params else None,
+                match=c.params.get("match"))
+        except ValueError as e:
+            raise CmdError(str(e))
+        return "OK"
+    if c.action == "list":
+        return [f["name"] for f in failpoint.active()]
+    if c.action == "list-detail":
+        return [f"{f['name']} -> probability {f['probability']} "
+                f"count {f['count'] if f['count'] is not None else 'inf'} "
+                f"match {f['match'] or '*'} hits {f['hits']}"
+                for f in failpoint.active()]
+    if c.action in ("remove", "force-remove"):
+        if not failpoint.disarm(c.alias) and c.action == "remove":
+            raise CmdError(f"fault {c.alias!r} not armed")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for fault")
+
+
 def _h_resolver(app: Application, c: Command):
     """The reference's resolver is a singleton named "(default)"
     (ResolverHandle.java:10-16); dns-cache lives inside it."""
@@ -1269,6 +1327,7 @@ def _h_docker(app: Application, c: Command):
 
 
 _HANDLERS = {
+    "fault": _h_fault,
     "event-log": _h_eventlog,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,

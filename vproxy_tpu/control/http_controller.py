@@ -130,8 +130,19 @@ class HttpController:
         self._srv: Optional[HttpServer] = None
 
     def start(self) -> None:
+        from ..utils import failpoint, lifecycle
         srv = HttpServer(self.loop)
-        srv.get("/healthz", lambda r: r.resp.end({"status": "ok"}))
+
+        def healthz(r: RoutingContext) -> None:
+            # `draining` + 503 once graceful drain begins, so upstream
+            # LBs probing this controller steer traffic away
+            if lifecycle.is_draining():
+                r.resp.status(503).end({"status": "draining"})
+            else:
+                r.resp.end({"status": "ok"})
+
+        srv.get("/healthz", healthz)
+        srv.get("/faults", lambda r: r.resp.end(failpoint.active()))
         srv.post("/api/v1/command", self._command)
         srv.all("/api/v1/module/*", self._module)
         srv.listen(self.bind_port, self.bind_ip)

@@ -61,16 +61,19 @@ def current_config(app: Application) -> str:
     for ck in app.cert_keys.values():
         lines.append(f"add cert-key {ck.alias} cert {ck.cert_path} "
                      f"key {ck.key_path}")
+    from ..components.tcplb import MAX_SESSIONS as _MAX_SESSIONS
     for lb in app.tcp_lbs.values():
         secg_part = ("" if lb.security_group.alias == "(allow-all)"
                      else f" security-group {lb.security_group.alias}")
         ck_part = ("" if not lb.cert_keys else
                    " cert-key " + ",".join(ck.alias for ck in lb.cert_keys))
+        ms_part = ("" if lb.max_sessions == _MAX_SESSIONS
+                   else f" max-sessions {lb.max_sessions}")
         lines.append(
             f"add tcp-lb {lb.alias} address {lb.bind_ip}:{lb.bind_port} "
             f"upstream {lb.backend.alias} protocol {lb.protocol} "
             f"timeout {lb.timeout_ms} "
-            f"in-buffer-size {lb.in_buffer_size}{secg_part}{ck_part}")
+            f"in-buffer-size {lb.in_buffer_size}{secg_part}{ck_part}{ms_part}")
     for s in app.socks5_servers.values():
         flag = " allow-non-backend" if s.allow_non_backend else ""
         secg_part = ("" if s.security_group.alias == "(allow-all)"

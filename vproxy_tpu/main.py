@@ -32,6 +32,16 @@ Env flags (the reference's -D system-property layer, Config.java):
   VPROXY_TPU_DIST_COORD=host:port        jax.distributed coordinator
   VPROXY_TPU_DIST_NPROC=n                ... process count
   VPROXY_TPU_DIST_PROCID=i               ... this process's id
+
+Failure-containment knobs (docs/robustness.md):
+  VPROXY_TPU_CONNECT_RETRIES=n           backend connect retries (default 2)
+  VPROXY_TPU_CONNECT_TIMEOUT_MS=ms       backend connect deadline (3000)
+  VPROXY_TPU_RETRY_BUDGET=r              retries <= r * accepts (default .2)
+  VPROXY_TPU_MAX_SESSIONS=n              per-LB overload shed threshold
+  VPROXY_TPU_DRAIN_S=s                   SIGTERM/`drain` grace (default 15)
+  VPROXY_TPU_EJECT_FAILURES=n            passive-eject streak (default 3)
+  VPROXY_TPU_EJECT_BASE_S / _CAP_S       eject backoff base/cap (5 / 300)
+  VPROXY_TPU_FAILPOINTS=spec             arm failpoints at boot
 """
 from __future__ import annotations
 
@@ -156,19 +166,41 @@ def main(argv: list[str] | None = None) -> int:
         print(f"loaded {n} commands from {persist.LAST_CONFIG}")
 
     stop = threading.Event()
+    want_drain = threading.Event()  # SIGTERM/`drain`: graceful window
 
+    # the handlers only set events: file I/O (or any lock) inside a
+    # Python signal-handler frame can re-enter mid-bytecode — the save
+    # now runs on the main thread after stop.wait(), post-drain
     def on_signal(signum, frame):
-        if not opts["no_save"]:
-            try:
-                persist.save(app)
-            except OSError as e:
-                print(f"save failed: {e}", file=sys.stderr)
+        if signum == signal.SIGTERM:
+            want_drain.set()
         stop.set()
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
     if hasattr(signal, "SIGUSR2"):
-        signal.signal(signal.SIGUSR2, lambda s, f: persist.save(app))
+        # the handler only sets an event (run_on_loop would take a
+        # non-reentrant lock inside the signal frame); a dedicated
+        # daemon thread does the actual save
+        want_save = threading.Event()
+
+        def usr2_saver() -> None:
+            while True:
+                want_save.wait()
+                want_save.clear()
+                if stop.is_set():
+                    return
+                try:
+                    persist.save(app)
+                except OSError as e:
+                    print(f"save failed: {e}", file=sys.stderr)
+
+        threading.Thread(target=usr2_saver, daemon=True,
+                         name="usr2-save").start()
+        signal.signal(signal.SIGUSR2, lambda s, f: want_save.set())
+
+    # the `drain` operator command funnels to the same exit path
+    app.on_drain_request.append(lambda: (want_drain.set(), stop.set()))
 
     if not opts["no_save"]:
         persist.start_auto_save(app)
@@ -199,6 +231,20 @@ def main(argv: list[str] | None = None) -> int:
         threading.Thread(target=repl, daemon=True, name="stdio").start()
 
     stop.wait()
+    if want_drain.is_set():
+        # graceful drain (SIGTERM / `drain`): listeners close, /healthz
+        # flips to draining, pumps finish within VPROXY_TPU_DRAIN_S
+        drain_s = float(os.environ.get("VPROXY_TPU_DRAIN_S", "15"))
+        app.request_drain()  # no-op if the drain command already ran
+        done = app.drain_wait(drain_s)
+        print("drained cleanly" if done
+              else f"drain window ({drain_s:.0f}s) closed; exiting",
+              file=sys.stderr)
+    if not opts["no_save"]:
+        try:
+            persist.save(app)
+        except OSError as e:
+            print(f"save failed: {e}", file=sys.stderr)
     updater.close()
     app.close()
     return 0

@@ -11,9 +11,11 @@ checks, controllers).
 """
 from __future__ import annotations
 
+import errno
 from typing import Callable, Optional
 
 from . import vtl
+from ..utils import failpoint
 from .eventloop import SelectorEventLoop
 
 
@@ -49,7 +51,7 @@ class Connection:
     MAX_OUT = 4 * 1024 * 1024
 
     def __init__(self, loop: SelectorEventLoop, fd: int, remote, local=None,
-                 connecting: bool = False):
+                 connecting: bool = False, connect_timeout_ms: int = 0):
         self.loop = loop
         self.fd = fd
         self.remote = remote  # (ip, port)
@@ -62,18 +64,51 @@ class Connection:
         self.bytes_in = 0
         self.bytes_out = 0
         self._connecting = connecting
+        self._fp_hang = False  # backend.connect.hang failpoint armed
         self._closing = False
         self._shut_wr_pending = False
         self._interest = 0
+        self._conn_deadline = None
         loop.add(fd, 0, self._on_event)
         self._want(vtl.EV_WRITE if connecting else vtl.EV_READ)
+        if connecting and connect_timeout_ms > 0:
+            # a peer that neither completes nor refuses the connect (SYN
+            # blackhole) must surface as on_closed(-ETIMEDOUT), not a
+            # forever-pending handler
+            def _timed_out() -> None:
+                self._conn_deadline = None
+                if self._connecting and not (self.closed or self.detached):
+                    self.close(-errno.ETIMEDOUT)
+
+            self._conn_deadline = loop.delay(connect_timeout_ms, _timed_out)
 
     # ---------------------------------------------------------- public api
 
     @classmethod
-    def connect(cls, loop: SelectorEventLoop, ip: str, port: int) -> "Connection":
+    def connect(cls, loop: SelectorEventLoop, ip: str, port: int,
+                failpoints: bool = True,
+                timeout_ms: int = 0) -> "Connection":
+        """failpoints=False opts this connect out of the
+        backend.connect.* injection sites — health-check probes pass it
+        so they can't consume count-armed data-plane faults (they have
+        their own dedicated site, hc.force_down). timeout_ms > 0 bounds
+        the connect: on expiry the handler sees on_closed(-ETIMEDOUT)."""
+        ctx = f"{ip}:{port}"
+        if failpoints and failpoint.hit("backend.connect.refuse", ctx):
+            raise ConnectionRefusedError(errno.ECONNREFUSED,
+                                         f"failpoint refused {ctx}")
         fd = vtl.tcp_connect(ip, port)
-        return cls(loop, fd, (ip, port), connecting=True)
+        conn = cls(loop, fd, (ip, port), connecting=True,
+                   connect_timeout_ms=timeout_ms)
+        if failpoints and failpoint.hit("backend.connect.hang", ctx):
+            # the connect never completes and never errors, leaving only
+            # the caller's timeout path; interest drops to 0 so the
+            # level-triggered writable fd can't busy-spin the loop
+            # (_want ignores all later re-arms — e.g. a write() before
+            # the flag, which would otherwise restore EV_WRITE forever)
+            conn._want(0)
+            conn._fp_hang = True
+        return conn
 
     @classmethod
     def connect_unix(cls, loop: SelectorEventLoop, path: str) -> "Connection":
@@ -104,6 +139,7 @@ class Connection:
         if self.closed or self.detached:
             return
         self.closed = True
+        self._cancel_conn_deadline()
         self.loop.remove(self.fd)
         vtl.close(self.fd)
         self.handler.on_closed(self, err)
@@ -142,6 +178,7 @@ class Connection:
         if self.closed:
             raise OSError("closed")
         self.detached = True
+        self._cancel_conn_deadline()
         self.loop.remove(self.fd)
         return self.fd
 
@@ -153,8 +190,13 @@ class Connection:
 
     # ---------------------------------------------------------- internals
 
+    def _cancel_conn_deadline(self) -> None:
+        if self._conn_deadline is not None:
+            self._conn_deadline.cancel()
+            self._conn_deadline = None
+
     def _want(self, interest: int) -> None:
-        if self.closed or self.detached:
+        if self.closed or self.detached or self._fp_hang:
             return
         if interest != self._interest:
             self.loop.modify(self.fd, interest)
@@ -180,8 +222,11 @@ class Connection:
     def _on_event_inner(self, fd: int, ev: int) -> None:
         if self.closed or self.detached:
             return
+        if self._fp_hang:
+            return  # failpoint: this connect never resolves
         if self._connecting:
             self._connecting = False
+            self._cancel_conn_deadline()
             err = vtl.finish_connect(fd)
             if err != 0:
                 self.close(-err)

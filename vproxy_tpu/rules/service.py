@@ -464,6 +464,11 @@ class ClassifyService:
         self._deliver(reqs, idxs, matcher.snap_payload(snap))
 
     def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
+        from ..utils import failpoint
+        if failpoint.hit("device.dispatch.error", kind):
+            # injected device fault: exercises the host-oracle failover
+            # (and the down-until/re-probe machinery) deterministically
+            raise RuntimeError("failpoint device.dispatch.error")
         n = len(reqs)
         cap = pad_batch(n)
         if kind == "hint":

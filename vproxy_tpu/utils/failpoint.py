@@ -1,0 +1,159 @@
+"""Failpoint — deterministic, named fault-injection sites.
+
+The failure-containment layer (backend retry, passive outlier ejection,
+graceful drain, overload shed) is only trustworthy if every behavior is
+provable in tier-1 tests without ad-hoc socket monkeypatching. This
+module gives the data plane named injection sites that tests (and
+operators, via `add fault` / `remove fault` and `GET /faults`) can arm:
+
+    backend.connect.refuse   Connection.connect raises ECONNREFUSED
+    backend.connect.hang     the nonblocking connect never completes
+                             (and never errors) — exercises timeouts
+    device.dispatch.error    ClassifyService device batches raise,
+                             driving the host-oracle failover path
+    hc.force_down            health-check probes report failure
+    pump.abort               a just-registered splice pump is killed
+
+Each armed fault carries three independent gates, all optional:
+
+* probability p in (0, 1]  — fire on a coin flip (default 1.0). The
+  coin is a per-fault `random.Random(seed)` so a seeded arm replays the
+  same hit sequence — "deterministic" is the design goal, not a vibe.
+* count n                  — fire at most n times, then auto-disarm.
+* match m                  — fire only when the site's context string
+  (e.g. the backend "ip:port") contains m.
+
+The hot-path cost when nothing is armed is one module-global bool read
+(`_armed` flips with registry size); sites call `failpoint.hit(name,
+ctx)` unconditionally.
+
+Env bootstrap (mirrors the VPROXY_TPU_* knob layer): arm faults at
+import with `VPROXY_TPU_FAILPOINTS=name[:p[:n]][@match][,...]`, e.g.
+
+    VPROXY_TPU_FAILPOINTS=backend.connect.refuse:0.5@:8080,pump.abort::3
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Optional
+
+# the catalog of wired sites — arming anything else is a typo, and the
+# command surface must reject typos loudly (a fault that never fires
+# "passes" every chaos run)
+SITES = (
+    "backend.connect.refuse",
+    "backend.connect.hang",
+    "device.dispatch.error",
+    "hc.force_down",
+    "pump.abort",
+)
+
+_lock = threading.Lock()
+_registry: dict[str, "Fault"] = {}
+_armed = False  # lock-free fast-path gate, true iff _registry non-empty
+
+
+class Fault:
+    __slots__ = ("name", "probability", "count", "match", "hits", "_rng")
+
+    def __init__(self, name: str, probability: float = 1.0,
+                 count: Optional[int] = None, match: Optional[str] = None,
+                 seed: Optional[int] = None):
+        if name not in SITES:
+            raise ValueError(f"unknown failpoint {name!r} "
+                             f"(known: {', '.join(SITES)})")
+        if not 0.0 < probability <= 1.0:
+            raise ValueError(f"probability must be in (0, 1], got {probability}")
+        if count is not None and count <= 0:
+            raise ValueError(f"count must be positive, got {count}")
+        self.name = name
+        self.probability = probability
+        self.count = count  # remaining fires; None = unlimited
+        self.match = match
+        self.hits = 0
+        self._rng = random.Random(seed if seed is not None else name)
+
+    def describe(self) -> dict:
+        return {"name": self.name, "probability": self.probability,
+                "count": self.count, "match": self.match, "hits": self.hits}
+
+
+def arm(name: str, probability: float = 1.0, count: Optional[int] = None,
+        match: Optional[str] = None, seed: Optional[int] = None) -> None:
+    """Arm (or re-arm, replacing) a fault site."""
+    global _armed
+    f = Fault(name, probability, count, match, seed)
+    with _lock:
+        _registry[name] = f
+        _armed = True
+
+
+def disarm(name: str) -> bool:
+    """Disarm; returns False when the fault wasn't armed."""
+    global _armed
+    with _lock:
+        gone = _registry.pop(name, None) is not None
+        _armed = bool(_registry)
+    return gone
+
+
+def clear() -> None:
+    """Test hook: drop every armed fault."""
+    global _armed
+    with _lock:
+        _registry.clear()
+        _armed = False
+
+
+def active() -> list[dict]:
+    """Snapshot for `GET /faults` / `list fault`."""
+    with _lock:
+        return [f.describe() for f in _registry.values()]
+
+
+def hit(name: str, ctx: str = "") -> bool:
+    """Ask a site whether its fault fires for this event. Decrements a
+    count arm on fire and auto-disarms at zero. Safe from any thread."""
+    global _armed
+    if not _armed:
+        return False
+    with _lock:
+        f = _registry.get(name)
+        if f is None:
+            return False
+        if f.match is not None and f.match not in ctx:
+            return False
+        if f.probability < 1.0 and f._rng.random() >= f.probability:
+            return False
+        f.hits += 1
+        if f.count is not None:
+            f.count -= 1
+            if f.count <= 0:
+                del _registry[name]
+                _armed = bool(_registry)
+    from . import events
+    events.record("fault_injected", f"failpoint {name} fired",
+                  failpoint=name, ctx=ctx)
+    return True
+
+
+def _bootstrap_env() -> None:
+    """VPROXY_TPU_FAILPOINTS=name[:p[:n]][@match],... at import."""
+    spec = os.environ.get("VPROXY_TPU_FAILPOINTS", "")
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        body, _, match = part.partition("@")
+        fields = body.split(":")
+        try:
+            name = fields[0]
+            p = float(fields[1]) if len(fields) > 1 and fields[1] else 1.0
+            n = int(fields[2]) if len(fields) > 2 and fields[2] else None
+            arm(name, p, n, match or None)
+        except ValueError as e:
+            import sys
+            print(f"VPROXY_TPU_FAILPOINTS: skipping {part!r}: {e}",
+                  file=sys.stderr)
+
+
+_bootstrap_env()

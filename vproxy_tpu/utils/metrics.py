@@ -429,6 +429,7 @@ def launch_inspection_http(loop, ip: str, port: int):
     85-104) plus the flight-recorder dump. Returns the HttpServer
     (close() to stop)."""
     from ..lib.vserver import HttpServer
+    from . import failpoint, lifecycle
     from .events import FlightRecorder
 
     gi = GlobalInspection.get()
@@ -449,6 +450,16 @@ def launch_inspection_http(loop, ip: str, port: int):
         ctx.resp.end(FlightRecorder.get().snapshot(last))
 
     srv.get("/events", events)
-    srv.get("/healthz", lambda ctx: ctx.resp.end(b"OK"))
+    srv.get("/faults", lambda ctx: ctx.resp.end(failpoint.active()))
+
+    def healthz(ctx) -> None:
+        # draining flips to 503 so upstream LB health probes steer away
+        # while in-flight sessions finish (utils/lifecycle)
+        if lifecycle.is_draining():
+            ctx.resp.status(503).end(b"draining")
+        else:
+            ctx.resp.end(b"OK")
+
+    srv.get("/healthz", healthz)
     srv.listen(port, ip)
     return srv
