@@ -1,0 +1,144 @@
+"""Round-6 verify scenario: accept-path fast lane, driven end-to-end
+through the public surface (real sockets, real LB, real classify)."""
+import json, os, socket, threading, time
+
+from vproxy_tpu.components.elgroup import EventLoopGroup
+from vproxy_tpu.components.servergroup import HealthCheckConfig, ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.engine import HintMatcher
+from vproxy_tpu.rules.ir import Hint, HintRule
+from vproxy_tpu.rules.service import ClassifyService
+from vproxy_tpu.utils.metrics import GlobalInspection
+from vproxy_tpu.net import vtl
+
+report = {"provider": vtl.PROVIDER}
+
+class Backend:
+    """Server-first id byte, then echo (the pool's hardest case)."""
+    def __init__(self, sid):
+        self.sid = sid.encode(); self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0)); self.sock.listen(128)
+        self.port = self.sock.getsockname()[1]; self.alive = True
+        threading.Thread(target=self._serve, daemon=True).start()
+    def _serve(self):
+        while self.alive:
+            try: c, _ = self.sock.accept()
+            except OSError: return
+            threading.Thread(target=self._conn, args=(c,), daemon=True).start()
+    def _conn(self, c):
+        try:
+            c.sendall(self.sid)
+            while True:
+                d = c.recv(65536)
+                if not d: break
+                c.sendall(d)
+        except OSError: pass
+        finally: c.close()
+    def close(self):
+        self.alive = False
+        try: self.sock.close()
+        except OSError: pass
+
+def session(port, payload=b"x" * 2048):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5); c.settimeout(5)
+    try:
+        sid = c.recv(1); assert len(sid) == 1, "no backend id"
+        c.sendall(payload)
+        got = b""
+        while len(got) < len(payload):
+            d = c.recv(65536)
+            assert d, "echo truncated"
+            got += d
+        assert got == payload, "echo corrupted"
+        return sid.decode()
+    finally: c.close()
+
+elg = EventLoopGroup("v", 2)
+b1, b2 = Backend("A"), Backend("B")
+g = ServerGroup("vg", elg, HealthCheckConfig(timeout_ms=500, period_ms=100,
+                                             up=1, down=100), "wrr")
+g.add("a", "127.0.0.1", b1.port); g.add("b", "127.0.0.1", b2.port)
+while sum(1 for s in g.servers if s.healthy) < 2: time.sleep(0.02)
+ups = Upstream("vu"); ups.add(g)
+
+# --- 1. tcp splice with warm pool + defer accept: 200 byte-verified
+# server-first sessions, both backends served, pool hits observed
+lb = TcpLB("v-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp", pool_size=4)
+lb.start()
+ids = [session(lb.bind_port) for _ in range(200)]
+hits = GlobalInspection.get().get_counter(
+    "vproxy_lb_pool_total", lb="v-lb", result="hit").value()
+report["splice_sessions"] = len(ids)
+report["splice_ids"] = {i: ids.count(i) for i in set(ids)}
+report["pool_hits"] = hits
+assert set(ids) == {"A", "B"} and hits > 0
+
+# --- 2. backend dies mid-run: sessions keep completing (retry/eject)
+b1.close()
+ids2 = [session(lb.bind_port) for _ in range(40)]
+report["failover_ok"] = ids2.count("B") == 40 or set(ids2) <= {"A", "B"}
+report["failover_B"] = ids2.count("B")
+assert all(i in ("A", "B") for i in ids2)
+assert ids2[-10:] == ["B"] * 10, "never converged onto the live backend"
+lb.stop()
+
+# --- 3. http-splice: Host-header hint classify (inline fast lane) picks
+# the annotated group
+b3, b4 = Backend("C"), Backend("D")  # raw echo; http-splice still splices
+g3 = ServerGroup("vg3", elg, HealthCheckConfig(timeout_ms=500, period_ms=100,
+                                               up=1, down=100), "wrr")
+g4 = ServerGroup("vg4", elg, HealthCheckConfig(timeout_ms=500, period_ms=100,
+                                               up=1, down=100), "wrr")
+g3.add("c", "127.0.0.1", b3.port); g4.add("d", "127.0.0.1", b4.port)
+while not (g3.servers[0].healthy and g4.servers[0].healthy): time.sleep(0.02)
+ups2 = Upstream("vu2")
+ups2.add(g3, annotations=HintRule(host="c.example.com"))
+ups2.add(g4, annotations=HintRule(host="d.example.com"))
+os.environ["VPROXY_TPU_DEFER_ACCEPT"] = "1"  # client-first flow: safe
+lb2 = TcpLB("v-lb2", elg, elg, "127.0.0.1", 0, ups2, protocol="http-splice")
+lb2.start()
+def http_session(host):
+    c = socket.create_connection(("127.0.0.1", lb2.bind_port), timeout=5)
+    c.settimeout(5)
+    try:
+        c.sendall(b"GET / HTTP/1.1\r\nhost: %s\r\n\r\n" % host.encode())
+        return c.recv(64)[:1].decode()  # backend id byte (echo server)
+    finally: c.close()
+for _ in range(5):
+    assert http_session("c.example.com") == "C"
+    assert http_session("d.example.com") == "D"
+report["http_hint_routing"] = "ok (defer_accept=1)"
+os.environ["VPROXY_TPU_DEFER_ACCEPT"] = "0"
+lb2.stop()
+
+# --- 4. inline classify latency contract at the service boundary
+rules = [HintRule(host=f"svc{i}.v.example.com") for i in range(20000)]
+m = HintMatcher(rules, backend="host")
+svc = ClassifyService(mode="auto")
+lat = []
+for q in range(2000):
+    i = (q * 7919) % 20000
+    fired = []
+    t0 = time.perf_counter_ns()
+    svc.submit_hint(m, Hint.of_host(f"svc{i}.v.example.com"),
+                    lambda idx, _pl: fired.append(idx))
+    lat.append((time.perf_counter_ns() - t0) / 1000.0)
+    assert fired and fired[0] == i
+import numpy as np
+report["inline_p50_us"] = round(float(np.percentile(lat, 50)), 1)
+report["inline_p99_us"] = round(float(np.percentile(lat, 99)), 1)
+# winner parity vs the reference-scan oracle on a sample
+for i in (0, 77, 7919, 19999):
+    h = Hint.of_host(f"svc{i}.v.example.com")
+    fired = []
+    svc.submit_hint(m, h, lambda idx, _pl: fired.append(idx))
+    assert fired[0] == oracle.search(rules, h)
+report["oracle_parity"] = "ok"
+assert report["inline_p99_us"] < 50.0, report
+svc.close()
+
+for x in (b2, b3, b4): x.close()
+g.close(); g3.close(); g4.close(); elg.close()
+print(json.dumps(report, indent=1))

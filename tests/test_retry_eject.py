@@ -383,3 +383,62 @@ def test_wrr_exclude_skips_tried_backends(stack):
         c = ups.next(b"", exclude={a})
         assert c is not None and c.svr is b
     assert ups.next(b"", exclude={a, b}) is None
+
+
+def test_pooled_handover_failure_respects_retry_budget(stack, monkeypatch):
+    """Pool <-> retry-budget interplay: the fresh-connect fallback after
+    a pooled handover failure is charged to the SAME per-LB budget as
+    any other retry — with the budget pinned to zero the session is
+    closed instead of dialing, and the budget_exhausted counter says
+    so."""
+    monkeypatch.setattr(SG, "EJECT_FAILURES", 10_000)
+    elg = stack["make_elg"](1)
+    s1 = IdServer("A")
+    stack["servers"].append(s1)
+    g = ServerGroup("g-pb", elg, HealthCheckConfig(
+        timeout_ms=500, period_ms=100, up=1, down=100), "wrr")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    wait_healthy(g, 1)
+    ups = Upstream("u-pb")
+    ups.add(g)
+    lb = TcpLB("lb-pb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               pool_size=2)
+    stack["lbs"].append(lb)
+    lb.start()
+
+    # warm the pool
+    deadline = time.time() + 8
+    from vproxy_tpu.utils.metrics import GlobalInspection as GI
+
+    def pool_hits():
+        return GI.get().get_counter("vproxy_lb_pool_total", lb=lb.alias,
+                                    result="hit").value()
+    while pool_hits() < 1:
+        assert time.time() < deadline
+        assert tcp_get_id(lb.bind_port) == "A"
+        time.sleep(0.01)
+
+    # zero budget: a pooled failure may NOT convert into connect load
+    lb._retry_budget.ratio = 0.0
+    lb._retry_budget.burst = 0
+    before = _retries(lb, "budget_exhausted")
+    failpoint.arm("pool.handover.dead", count=1, match=f":{s1.port}")
+    saw_close = False
+    deadline = time.time() + 8
+    while failpoint.active():
+        assert time.time() < deadline, "fault never consumed"
+        sid = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                       timeout=5)
+        sid.settimeout(5)
+        got = sid.recv(8)
+        sid.close()
+        if got == b"":
+            saw_close = True  # the budget-denied session was shed
+        time.sleep(0.01)
+    assert saw_close
+    assert _retries(lb, "budget_exhausted") >= before + 1
+    deadline = time.time() + 5
+    while lb.active_sessions and time.time() < deadline:
+        time.sleep(0.02)
+    assert lb.active_sessions == 0  # no session-count leak on that path

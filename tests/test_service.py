@@ -306,6 +306,7 @@ def test_latency_budget_reroutes_lone_big_table_queries():
     periodic re-probes of the device)."""
     svc = ClassifyService.get()
     assert svc.mode == "auto"
+    svc.inline_lone = False  # exercise the budget policy, not the lane
     svc.budget_us = 1000.0  # 1ms budget
     m = HintMatcher(mk_rules(300))  # > SMALL_TABLE
     # make the device path artificially slow (tunnel-like: 50ms)
@@ -344,6 +345,7 @@ def test_latency_budget_reroutes_lone_big_table_queries():
 def test_latency_budget_off_keeps_device_for_lone_big_queries():
     svc = ClassifyService.get()
     assert svc.mode == "auto"
+    svc.inline_lone = False  # fast lane off: budget knob governs
     svc.budget_us = 0.0  # knob off -> previous behavior
     m = HintMatcher(mk_rules(300))
     m.match([Hint.of_host("warm.example.com")] * 16)
@@ -408,6 +410,7 @@ def test_micro_batches_always_ride_device_despite_budget():
     the device EWMA looks."""
     svc = ClassifyService.get()
     assert svc.mode == "auto"
+    svc.inline_lone = False  # decision-point asserts use the budget path
     svc.budget_us = 1.0  # absurdly tight budget
     svc._ewma["device"] = 1e6  # pretend the device is terrible
     svc._ewma["oracle"] = 10.0
@@ -430,3 +433,50 @@ def test_micro_batches_always_ride_device_despite_budget():
     # inline from the host index — no device round trip on the path
     assert svc.stats.oracle_queries >= n - 10
     assert svc.stats.budget_reroutes >= n - 10
+
+
+def test_inline_fast_lane_default_and_parity_vs_oracle():
+    """Round-6 fast lane: in auto mode EVERY lone query against a big
+    table is answered inline from the host index by default (no budget
+    gate, no device EWMA warm-up), and the winner is bit-for-bit the
+    oracle's across exact hosts, dot-suffix matches, uri prefixes,
+    port rules, wildcards and misses. Zero device dispatches."""
+    import threading as _t
+
+    svc = ClassifyService.get()
+    assert svc.mode == "auto" and svc.inline_lone
+
+    rules = []
+    for i in range(200):
+        rules.append(HintRule(host=f"svc{i}.lane.example.com"))
+    for i in range(60):
+        rules.append(HintRule(host=f"svc{i}.lane.example.com",
+                              uri=f"/api/v{i % 7}"))
+    for i in range(40):
+        rules.append(HintRule(host=f"svc{i}.lane.example.com", port=443))
+    rules.append(HintRule(host="*", uri="/fallback"))
+    m = HintMatcher(rules)  # > SMALL_TABLE: the lane is live
+    m.match([Hint.of_host("warm.example.com")] * 16)
+
+    queries = []
+    for i in range(0, 200, 7):
+        queries.append(Hint.of_host(f"svc{i}.lane.example.com"))
+        queries.append(Hint.of_host(f"x.svc{i}.lane.example.com"))
+        queries.append(Hint.of_host_uri(f"svc{i}.lane.example.com",
+                                        f"/api/v{i % 7}/deep"))
+        queries.append(Hint.of_host_port(f"svc{i}.lane.example.com", 443))
+    queries.append(Hint.of_host_uri("unknown.example.org", "/fallback/x"))
+    queries.append(Hint.of_host("no.match.example.org"))
+
+    caller = _t.get_ident()
+    for h in queries:
+        fired = []
+        svc.submit_hint(m, h,
+                        lambda idx, _pl: fired.append((idx, _t.get_ident())))
+        # the fast-lane contract: answered before submit returns, on the
+        # submitting thread
+        assert fired and fired[0][1] == caller, h
+        assert fired[0][0] == oracle.search(rules, h), h
+    assert svc.stats.dispatches == 0
+    assert svc.stats.device_queries == 0
+    assert svc.stats.inline_fast >= len(queries)

@@ -205,7 +205,12 @@ def run(clients: int = 4, requests: int = 120, payload_len: int = 4096,
         time.sleep(0.02)
     ups = Upstream("chaos-u")
     ups.add(group)
-    lb = TcpLB("chaos-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp")
+    # warm backend pool ON (round 6): the chaos floor must hold with
+    # pooled handovers in the path — eject drains pools, stale sockets
+    # fall back to fresh connects, server-first id bytes survive parking
+    pool_size = int(os.environ.get("CHAOS_POOL", "4"))
+    lb = TcpLB("chaos-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               pool_size=pool_size)
     lb.start()
     app = Application.create(workers=1)
     app.tcp_lbs["chaos-lb"] = lb
@@ -305,6 +310,7 @@ def run(clients: int = 4, requests: int = 120, payload_len: int = 4096,
     report["total_sessions"] = total
     report["ok_sessions"] = ok
     report["success_rate"] = ok / total if total else 0.0
+    report["pool_size"] = pool_size
     return report
 
 

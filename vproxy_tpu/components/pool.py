@@ -6,9 +6,23 @@ kept alive by a pluggable keepalive hook, handed out ready-to-use
 (used by the reference for conn-transfer / WebSocks "holding"
 connections). A connection that dies while pooled is replaced after a
 short retry delay. All state is loop-thread-confined.
+
+Two accept-fast-lane options (TcpLB's warm backend pool rides both):
+
+* park_reads=True — pooled connections drop read interest while idle,
+  so early backend bytes (server-first protocols: the banner a backend
+  sends on connect) stay queued in the kernel and reach the client
+  through the splice pump after handover instead of being consumed and
+  dropped by on_pooled_data. The cost: a peer's clean FIN while parked
+  goes unnoticed until the taker validates (MSG_PEEK) at handover.
+* idle_expire_ms>0 — connections pooled longer than this are closed on
+  the keepalive sweep and replaced, bounding how stale a parked socket
+  can get (backends commonly reap idle connections server-side; expiry
+  keeps the pool ahead of their reaper).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional
 
 from ..net.connection import Connection, Handler
@@ -25,6 +39,12 @@ class PoolHandler:
         """Create a connecting Connection (raise OSError on failure)."""
         raise NotImplementedError
 
+    def on_warm(self, conn: Connection) -> None:
+        """A pool connect completed (the socket is now idle-warm).
+        TcpLB reports backend connect success here — a refill IS a
+        fresh data-plane connect, and the passive-ejection failure
+        streak must clear on it like on any other successful dial."""
+
     def keepalive(self, conn: Connection) -> None:
         """Called periodically on each idle pooled connection."""
 
@@ -34,13 +54,17 @@ class PoolHandler:
 
 class ConnectionPool:
     def __init__(self, loop: SelectorEventLoop, handler: PoolHandler,
-                 capacity: int, keepalive_ms: int = KEEPALIVE_MS):
+                 capacity: int, keepalive_ms: int = KEEPALIVE_MS,
+                 park_reads: bool = False, idle_expire_ms: int = 0):
         self.loop = loop
         self.handler = handler
         self.capacity = capacity
         self.keepalive_ms = keepalive_ms
+        self.park_reads = park_reads
+        self.idle_expire_ms = idle_expire_ms
         self._idle: List[Connection] = []   # connected, ready to hand out
         self._connecting = 0
+        self.expired = 0                    # idle-expiry closures (stats)
         self.closed = False
         self._ka = None
 
@@ -68,7 +92,13 @@ class ConnectionPool:
         if self.closed:
             conn.close()
             return
+        if self.park_reads:
+            # early backend bytes stay in the kernel for the pump; the
+            # taker validates liveness with a MSG_PEEK at handover
+            conn.pause_reading()
+        conn._pooled_at = time.monotonic()
         self._idle.append(conn)
+        self.handler.on_warm(conn)
 
     def _on_dead(self, conn: Connection, connected: bool) -> None:
         if connected:
@@ -80,7 +110,14 @@ class ConnectionPool:
             self.loop.delay(RETRY_MS, self._fill)
 
     def _keepalive_all(self) -> None:
+        now = time.monotonic()
         for c in list(self._idle):
+            if (self.idle_expire_ms > 0
+                    and (now - getattr(c, "_pooled_at", now)) * 1000
+                    >= self.idle_expire_ms):
+                self.expired += 1
+                c.close()  # _on_dead removes it and schedules a refill
+                continue
             self.handler.keepalive(c)
 
     # ------------------------------------------------------------- public

@@ -320,6 +320,7 @@ class ServerGroup:
         return s
 
     def remove(self, name: str) -> None:
+        removed = None
         with self._lock:
             for i, s in enumerate(self.servers):
                 if s.name == name:
@@ -328,8 +329,14 @@ class ServerGroup:
                     chk = self._checkers.pop(name, None)
                     if chk:
                         chk.stop()
-                    return
-            raise KeyError(name)
+                    removed = s
+                    break
+            else:
+                raise KeyError(name)
+        # removal IS a DOWN edge for listeners (outside the lock, like
+        # every notify): a TcpLB's warm pools for the decommissioned
+        # backend must drain now, not keep redialing its address forever
+        self._notify(removed, False)
 
     def replace_ip(self, name: str, new_ip: str) -> None:
         """Swap a server's address in place (ServerGroup.replaceIp
@@ -377,6 +384,14 @@ class ServerGroup:
 
     def on_health_change(self, cb: Callable[[ServerHandle, bool], None]) -> None:
         self._listeners.append(cb)
+
+    def off_health_change(self, cb: Callable[[ServerHandle, bool], None]) -> None:
+        """Unregister (idempotent): a stopped TcpLB's pool-drain listener
+        must not keep firing — or keep the LB alive — forever."""
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
 
     def _notify(self, svr: ServerHandle, up: bool) -> None:
         from ..utils import events

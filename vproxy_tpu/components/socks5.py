@@ -322,8 +322,9 @@ class _Socks5Session(Handler):
                               if conn.remote else "?")
                 ffd = conn.detach()
                 bfd = bconn.detach()
-                vtl.set_nodelay(ffd)
-                vtl.set_nodelay(bfd)
+                if not vtl.pump_sets_nodelay():  # pre-r6 .so only
+                    vtl.set_nodelay(ffd)
+                    vtl.set_nodelay(bfd)
                 pid = session.loop.pump(ffd, bfd, lb.in_buffer_size,
                                         self._done)
                 self._pid = pid

@@ -15,6 +15,7 @@ full per-request/per-stream L7 engine (components/l7.py).
 """
 from __future__ import annotations
 
+import errno
 import os
 import threading
 import time
@@ -31,6 +32,7 @@ from ..utils.log import Logger
 from ..utils.metrics import accept_stage_observe
 from .elgroup import EventLoopGroup
 from .l7 import L7Engine
+from .pool import ConnectionPool, PoolHandler
 from .secgroup import SecurityGroup
 from .servergroup import Connector
 from .upstream import Upstream
@@ -43,6 +45,17 @@ RETRY_BUDGET_RATIO = float(os.environ.get("VPROXY_TPU_RETRY_BUDGET", "0.2"))
 MAX_SESSIONS = int(os.environ.get("VPROXY_TPU_MAX_SESSIONS", "1000000"))
 CONNECT_TIMEOUT_MS = int(os.environ.get("VPROXY_TPU_CONNECT_TIMEOUT_MS",
                                         "3000"))
+# accept-fast-lane knobs (docs/perf.md): pre-connected idle sockets per
+# (worker loop, backend) so short connections skip the backend-connect
+# round trip entirely. 0 = off (the default: pooling assumes the backend
+# tolerates idle warm connections).
+POOL_SIZE = int(os.environ.get("VPROXY_TPU_POOL_SIZE", "0"))
+POOL_IDLE_S = float(os.environ.get("VPROXY_TPU_POOL_IDLE_S", "30"))
+# sockets warmed within this window skip the MSG_PEEK liveness check at
+# handover (a socket this young is as trustworthy as a fresh connect;
+# RSTs are reaped by EV_ERROR, clean FINs by the peek once it ages past
+# the window, and the residual race by the handover-failure fallback)
+POOL_VALIDATE_S = float(os.environ.get("VPROXY_TPU_POOL_VALIDATE_S", "1"))
 
 
 class RetryBudget:
@@ -94,6 +107,32 @@ class RetryBudget:
             return True
 
 
+class _LBPoolHandler(PoolHandler):
+    """How TcpLB's warm pool dials one backend: a plain data-plane
+    connect (failpoint-gated like any other, bounded by the LB's connect
+    timeout). No keepalive traffic — protocol=tcp can't speak for the
+    backend's protocol — so staleness is bounded by idle expiry plus the
+    MSG_PEEK validation at handover. Refill successes report_success:
+    a pool fill is a real connect, and pooled traffic must keep clearing
+    the backend's passive-ejection streak the way classic connects do."""
+
+    __slots__ = ("svr", "group", "ip", "port", "timeout_ms")
+
+    def __init__(self, target: Connector, timeout_ms: int):
+        self.svr = target.svr
+        self.group = target.group
+        self.ip = target.ip
+        self.port = target.port
+        self.timeout_ms = timeout_ms
+
+    def connect(self, loop) -> Connection:
+        return Connection.connect(loop, self.ip, self.port,
+                                  timeout_ms=self.timeout_ms)
+
+    def on_warm(self, conn: Connection) -> None:
+        self.group.report_success(self.svr)
+
+
 class _SpliceBack(Handler):
     """Backend-connect handler for the splice path — ONE shared class
     (defining it per accept showed up as __build_class__ on the
@@ -101,12 +140,13 @@ class _SpliceBack(Handler):
 
     __slots__ = ("lb", "loop", "front_fd", "target", "head", "front",
                  "_pid", "tls_ctx", "t_acc", "t_back", "connected",
-                 "src_ip", "tried", "hint")
+                 "src_ip", "tried", "hint", "pooled")
 
     def __init__(self, lb, loop, front_fd: int, target: Connector,
                  head: bytes, front: str, tls_ctx: int = 0,
                  t_acc: Optional[float] = None, src_ip: bytes = b"",
-                 tried: Optional[set] = None, hint=None):
+                 tried: Optional[set] = None, hint=None,
+                 pooled: bool = False):
         self.lb = lb
         self.loop = loop
         self.front_fd = front_fd
@@ -122,6 +162,7 @@ class _SpliceBack(Handler):
         self.tried = tried if tried is not None else set()
         self.hint = hint           # classify hint: retries re-run the
                                    # original selection, not plain WRR
+        self.pooled = pooled       # adopted a warmed pool connection
 
     def on_connected(self, conn: Connection) -> None:
         self.connected = True
@@ -144,9 +185,17 @@ class _SpliceBack(Handler):
     def _handover(self, conn: Connection) -> None:
         if conn.detached or conn.closed:
             return
+        if self.pooled and self.tried:
+            # the retried session is now truly served (classic connects
+            # count this edge in on_connected; pooled ones count here)
+            self.lb._retries_total("success").incr()
         bfd = conn.detach()
-        vtl.set_nodelay(self.front_fd)
-        vtl.set_nodelay(bfd)
+        if not vtl.pump_sets_nodelay():
+            # prebuilt pre-r6 .so: its pump setup lacks pump_set_nodelay,
+            # so the explicit calls stay (r6+ does it in C — two fewer
+            # ctypes crossings per session)
+            vtl.set_nodelay(self.front_fd)
+            vtl.set_nodelay(bfd)
         if self.tls_ctx:
             pid = self.loop.pump_tls(self.front_fd, bfd, self.tls_ctx,
                                      self.lb.in_buffer_size, self._done)
@@ -192,6 +241,16 @@ class _SpliceBack(Handler):
                 self.tried, errno_, hint=self.hint)
             self.lb._sessions_delta(-1)
             return
+        if self.pooled and self._pid is None:
+            # a warmed connection died between validation and pump
+            # handover: counts as a connect failure (ejection streak) and
+            # falls back to a fresh connect under the retry budget
+            self.lb._pooled_handover_failed(
+                self.loop, self.front_fd, self.target, self.head,
+                self.front, self.t_acc, self.src_ip, self.tls_ctx,
+                self.tried, errno_, hint=self.hint)
+            self.lb._sessions_delta(-1)
+            return
         self.lb._sessions_delta(-1)
         # the backend connected and then died before pump handover — a
         # different failure domain than a refused connect, and the event
@@ -210,7 +269,7 @@ class TcpLB:
                  security_group: Optional[SecurityGroup] = None,
                  in_buffer_size: int = 65536, timeout_ms: int = 900_000,
                  cert_keys: Optional[list] = None,
-                 max_sessions: int = 0):
+                 max_sessions: int = 0, pool_size: int = -1):
         if protocol not in ("tcp", "http-splice") \
                 and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
@@ -243,6 +302,14 @@ class TcpLB:
         self._retry_budget = RetryBudget()
         self._retry_ctrs: dict[str, object] = {}
         self._overload_ctr = None
+        # warm backend pool (accept fast lane): per-(worker loop, backend)
+        # pre-connected idle sockets, lazily spawned on first use,
+        # drained on backend DOWN edges (hc or passive ejection)
+        self.pool_size = POOL_SIZE if pool_size < 0 else pool_size
+        self._pools: dict[tuple, ConnectionPool] = {}
+        self._pool_lock = threading.Lock()
+        self._pool_groups: set = set()   # groups with our health listener
+        self._pool_ctrs: dict[str, object] = {}
         # stats (cmd/ResourceType accepted-conn-count / bytes-in / bytes-out)
         self.accepted = 0
         self.active_sessions = 0
@@ -323,6 +390,11 @@ class TcpLB:
         for ss in self.server_socks:
             ss.loop.run_on_loop(ss.close)
         self.server_socks = []
+        self._drain_pools()
+        with self._pool_lock:
+            groups, self._pool_groups = self._pool_groups, set()
+        for g in groups:
+            g.off_health_change(self._on_pool_backend_health)
 
     def begin_drain(self) -> None:
         """Graceful drain: close the listeners so no new connections
@@ -340,6 +412,9 @@ class TcpLB:
             for ss in self.server_socks:
                 ss.loop.run_on_loop(ss.close)
             self.server_socks = []
+        # warm sockets are not in-flight work: release them immediately
+        # (the drain contract only protects established client sessions)
+        self._drain_pools()
 
     # ------------------------------------------------- failure containment
 
@@ -361,6 +436,121 @@ class TcpLB:
             self._overload_ctr = GlobalInspection.get().get_counter(
                 "vproxy_lb_overload_total", lb=self.alias)
         return self._overload_ctr
+
+    # ------------------------------------------------- warm backend pool
+
+    def _pool_total(self, result: str):
+        c = self._pool_ctrs.get(result)
+        if c is None:
+            from ..utils.metrics import GlobalInspection
+            c = self._pool_ctrs[result] = GlobalInspection.get().get_counter(
+                "vproxy_lb_pool_total", lb=self.alias, result=result)
+        return c
+
+    def set_pool_size(self, n: int) -> None:
+        """Hot-set the per-(loop, backend) warm-pool capacity (0 = off).
+        Existing pools are drained and lazily respawn at the new size on
+        the next accept that wants one."""
+        self.pool_size = max(0, n)
+        self._drain_pools()
+
+    def _drain_pools(self, svr=None) -> None:
+        """Close (and forget) pools — all of them, or one backend's
+        (DOWN edge / pooled-handover failure: its parked sockets are
+        presumed dead and must not be handed to more clients)."""
+        with self._pool_lock:
+            if svr is None:
+                doomed = list(self._pools.values())
+                self._pools = {}
+            else:
+                doomed = [p for k, p in self._pools.items() if k[1] is svr]
+                self._pools = {k: p for k, p in self._pools.items()
+                               if k[1] is not svr}
+        for p in doomed:
+            p.close()
+
+    def _on_pool_backend_health(self, svr, up: bool) -> None:
+        # ejection and hc-down take the same edge (ServerGroup._notify):
+        # either way the backend's warm sockets are no longer trustworthy
+        if not up:
+            self._drain_pools(svr)
+
+    def _pool_for(self, loop, target: Connector) -> Optional[ConnectionPool]:
+        if self.pool_size <= 0 or self.draining or not self.started:
+            return None
+        key = (id(loop), target.svr)
+        pool = self._pools.get(key)
+        if pool is None:
+            if not target.svr.healthy:
+                # a selection that raced the DOWN edge must not respawn
+                # a pool the edge just drained — no new DOWN will arrive
+                # to drain it while the backend stays down
+                return None
+            with self._pool_lock:
+                # re-check EVERYTHING under the lock: an accept racing
+                # stop()/begin_drain()/hot-set-0/the DOWN edge must not
+                # recreate a pool (and re-register the health listener)
+                # after the drain
+                if (self.pool_size <= 0 or self.draining
+                        or not self.started or not target.svr.healthy):
+                    return None
+                pool = self._pools.get(key)
+                if pool is None:
+                    # keepalive tick doubles as the idle-expiry sweep, so
+                    # it must run a few times per expiry window
+                    ka_ms = max(250, min(int(POOL_IDLE_S * 250), 15000))
+                    pool = self._pools[key] = ConnectionPool(
+                        loop, _LBPoolHandler(target,
+                                             self.connect_timeout_ms),
+                        self.pool_size, keepalive_ms=ka_ms,
+                        park_reads=True,
+                        idle_expire_ms=int(POOL_IDLE_S * 1000))
+                if target.group not in self._pool_groups:
+                    self._pool_groups.add(target.group)
+                    target.group.on_health_change(
+                        self._on_pool_backend_health)
+        return pool
+
+    def _pool_take(self, loop, target: Connector) -> Optional[Connection]:
+        """One validated warm connection, or None (pool off/empty). Must
+        run on the owning loop thread (it does: every _splice caller is
+        loop-confined)."""
+        pool = self._pool_for(loop, target)
+        if pool is None:
+            return None
+        while True:
+            conn = pool.get()
+            if conn is None:
+                self._pool_total("miss").incr()
+                return None
+            if self._pool_validate(conn):
+                self._pool_total("hit").incr()
+                return conn
+            self._pool_total("stale").incr()
+            conn.close()
+
+    @staticmethod
+    def _pool_validate(conn: Connection) -> bool:
+        """Parked sockets don't watch for EOF (reads are off so early
+        backend bytes survive for the pump) — so check liveness HERE,
+        with a MSG_PEEK: b'' means the peer already closed. Queued bytes
+        (server-first banner) are fine; they stay queued. Sockets still
+        inside the POOL_VALIDATE_S warm window skip the peek syscall."""
+        if conn.closed or conn.detached or conn.eof_seen:
+            return False
+        if (time.monotonic() - getattr(conn, "_pooled_at", 0.0)
+                < POOL_VALIDATE_S):
+            return True
+        if vtl.PROVIDER != "native":
+            # pure-python provider has no MSG_PEEK surface (recv_peek is
+            # native-only, like the SNI sniffer's gate): rely on the
+            # closed/eof checks above + the handover-failure fallback
+            return True
+        try:
+            data = vtl.recv_peek(conn.fd, 1)
+        except OSError:
+            return False
+        return data != b""  # None (nothing queued, alive) or bytes: ok
 
     def _take_retry_slot(self, tried: set, what: str, pick):
         """THE retry gate, shared by the splice/TLS path, Socks5 and the
@@ -428,6 +618,45 @@ class TcpLB:
             return
         self._splice(loop, front_fd, nxt, head, front, t_acc,
                      src_ip=src_ip, tls_ctx=tls_ctx, tried=tried, hint=hint)
+
+    def _pooled_handover_failed(self, loop, front_fd: int, target: Connector,
+                                head: bytes, front: str,
+                                t_acc: Optional[float], src_ip: bytes,
+                                tls_ctx: int, tried: set, err: int,
+                                hint=None) -> None:
+        """A warmed pool connection died at handover (post-validation).
+        One stale socket says little about the backend beyond this
+        session — but from the session's point of view it IS a failed
+        connect: report it (feeding the passive-ejection streak), drop
+        this backend's pools (its siblings were parked the same way and
+        are presumed equally stale), and retry with a FRESH connect
+        under the existing retry budget — same backend first while it is
+        still healthy (a restarted backend accepts new connects fine;
+        excluding it would strand single-backend groups), the normal
+        re-selection otherwise. The backend is NOT added to `tried`
+        here: if the fresh connect also fails, the ordinary
+        connect-failed path excludes it then."""
+        svr = target.svr
+        events.record(
+            "conn", f"{front} -> {target.ip}:{target.port} pooled "
+            "handover failed", lb=self.alias, err=err,
+            phase="pooled_handover_failed")
+        target.group.report_failure(svr, err)
+        self._drain_pools(svr)
+
+        def pick():
+            if svr.healthy and not svr.logic_delete:
+                return Connector(svr, target.group)
+            return self.backend.next_host(src_ip, hint,
+                                          exclude=set(tried) | {svr})
+
+        nxt = self._take_retry_slot(tried, front, pick)
+        if nxt is None:
+            vtl.close(front_fd)
+            return
+        self._splice(loop, front_fd, nxt, head, front, t_acc,
+                     src_ip=src_ip, tls_ctx=tls_ctx, tried=tried,
+                     hint=hint, fresh=True)
 
     # --------------------------------------------------------- data plane
 
@@ -802,10 +1031,29 @@ class TcpLB:
                 head: bytes, front: str = "?",
                 t_acc: Optional[float] = None, src_ip: bytes = b"",
                 tls_ctx: int = 0, tried: Optional[set] = None,
-                hint=None) -> None:
+                hint=None, fresh: bool = False) -> None:
+        """fresh=True bypasses the warm pool (the pooled-handover retry
+        path: it just drained this backend's pools and must dial a real
+        connect, not fish another parked socket)."""
         if tried is None:
             tried = set()
         svr = target.svr
+        if not fresh:
+            conn = self._pool_take(loop, target)
+            if conn is not None:
+                self._adopt_pooled(loop, front_fd, target, conn, head,
+                                   front, t_acc, src_ip, tls_ctx, tried,
+                                   hint)
+                return
+        # C fast lane: plain splice sessions (no head bytes, no TLS)
+        # ride vtl_pump_connect — ONE native call replaces the whole
+        # connect/register/nodelay/handover chain (~8 crossings).
+        # Armed failpoints force the classic path: the backend.connect.*
+        # injection sites live in Connection.connect.
+        if (not head and not tls_ctx and not failpoint.any_armed()
+                and self._fast_splice(loop, front_fd, target, front,
+                                      t_acc, src_ip, tried, hint)):
+            return
         svr.conn_count += 1
         self._sessions_delta(1)
         try:
@@ -825,3 +1073,125 @@ class TcpLB:
         back.set_handler(_SpliceBack(self, loop, front_fd, target, head,
                                      front, tls_ctx=tls_ctx, t_acc=t_acc,
                                      src_ip=src_ip, tried=tried, hint=hint))
+
+    def _fast_splice(self, loop, front_fd: int, target: Connector,
+                     front: str, t_acc: Optional[float], src_ip: bytes,
+                     tried: set, hint) -> bool:
+        """One-crossing backend connect + pump handover in the C loop
+        (net/eventloop.pump_connect). The connect resolves natively; a
+        refused/unreachable/timed-out backend comes back as a
+        connect_failed DONE with the client fd intact, feeding the SAME
+        retry/ejection machinery the python path uses. False = fast lane
+        unavailable (py provider / old .so) — caller takes the classic
+        path."""
+        pc = getattr(loop, "pump_connect", None)
+        if pc is None:
+            return False
+        lb = self
+        svr = target.svr
+        t_back = time.monotonic()
+        desc = f"{front} -> {target.ip}:{target.port}"
+        pid_box = [0]
+        reported = [False]  # connect success noted (streak reset) once
+
+        def _report_ok() -> None:
+            # the classic path clears the ejection streak one RTT after
+            # dialing (on_connected). The fast lane hears back at DONE
+            # (short sessions) or at the connect-deadline check the loop
+            # runs for still-open sessions (long streams) — a bounded
+            # delay of at most connect_timeout_ms, never hours.
+            if not reported[0]:
+                reported[0] = True
+                target.group.report_success(svr)
+                if tried:  # a retry attempt landed through the fast lane
+                    lb._retries_total("success").incr()
+
+        def done(a2b: int, b2a: int, err: int, flags: int = 0,
+                 connect_us: int = 0) -> None:
+            lb._unwatch_pump(loop, pid_box[0])
+            if flags & 1:  # backend never came up: retry machinery
+                # front_fd is still open (pump_fail_connect keeps it):
+                # same ownership contract as a python connect failure
+                svr.conn_count -= 1
+                lb._backend_connect_failed(
+                    loop, front_fd, target, b"", front, t_acc, src_ip,
+                    0, tried, err, hint=hint)
+                lb._sessions_delta(-1)
+                return
+            if flags & 2:
+                # torn down while STILL mid-connect (client RST'd the
+                # front fd first): says nothing about the backend —
+                # neither success (a report_success here would keep
+                # resetting a blackholed backend's ejection streak on
+                # every impatient client) nor failure. Plain teardown.
+                svr.conn_count -= 1
+                lb._sessions_delta(-1)
+                events.record("conn", f"{desc} client abort mid-connect",
+                              lb=lb.alias, err=err,
+                              phase="client_abort_connecting")
+                return
+            _report_ok()
+            # span semantics match the classic path (_handover observes
+            # once the backend is up): registration cost + the REAL
+            # connect duration the C side measured — observed late, at
+            # DONE, but histograms only care about the value
+            accept_stage_observe("handover",
+                                 reg_s + connect_us / 1e6)
+            if t_acc is not None:
+                accept_stage_observe(
+                    "total", (t_reg - t_acc) + connect_us / 1e6)
+            lb.bytes_in += a2b
+            lb.bytes_out += b2a
+            svr.bytes_in += a2b
+            svr.bytes_out += b2a
+            svr.conn_count -= 1
+            lb._sessions_delta(-1)
+            events.record("conn", f"{desc} closed", lb=lb.alias,
+                          bytes_in=a2b, bytes_out=b2a, err=err)
+
+        pid = pc(front_fd, target.ip, target.port, self.in_buffer_size,
+                 done, timeout_ms=self.connect_timeout_ms,
+                 on_connected=_report_ok)
+        if not pid:
+            return False  # registration failed: classic path retries
+        pid_box[0] = pid
+        t_reg = time.monotonic()
+        reg_s = t_reg - t_back
+        svr.conn_count += 1
+        self._sessions_delta(1)
+        self._watch_pump(loop, pid, desc)
+        return True
+
+    def _adopt_pooled(self, loop, front_fd: int, target: Connector,
+                      conn: Connection, head: bytes, front: str,
+                      t_acc: Optional[float], src_ip: bytes, tls_ctx: int,
+                      tried: set, hint) -> None:
+        """Hand a validated warm connection straight to the pump: the
+        accept path skips the whole backend-connect round trip (syscalls
+        + a loop iteration waiting for writability). Reads are already
+        parked, so a server-first backend's early bytes are still queued
+        in the kernel for the pump to deliver."""
+        svr = target.svr
+        svr.conn_count += 1
+        self._sessions_delta(1)
+        sb = _SpliceBack(self, loop, front_fd, target, head, front,
+                         tls_ctx=tls_ctx, t_acc=t_acc, src_ip=src_ip,
+                         tried=tried, hint=hint, pooled=True)
+        sb.connected = True
+        conn.set_handler(sb)
+        # NOTE: a retried session landing on a pooled socket counts its
+        # retries_total{success} in _handover, once the pump is actually
+        # registered — counting here would double-count when the pooled
+        # socket dies at handover and the fresh-connect fallback succeeds
+        if failpoint.hit("pool.handover.dead", f"{target.ip}:{target.port}"):
+            # deterministic stale-at-handover: exercises the pooled
+            # failure -> fresh-connect fallback (tests/test_pool_wiring)
+            conn.close(errno.ECONNRESET)
+            return
+        if head:
+            conn.write(head)  # a dead socket closes here -> on_closed
+            if conn.closed:   # handles the fallback; nothing more to do
+                return
+        if conn.out:
+            return  # _handover on drain, like a fresh connect
+        sb._handover(conn)

@@ -96,6 +96,7 @@ PARAM_KEYS = {
     "probability": "probability", "prob": "probability",
     "count": "count", "match": "match",
     "max-sessions": "max-sessions",
+    "pool-size": "pool-size",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -541,7 +542,9 @@ def _h_tl(app: Application, c: Command):
                                if "timeout" in c.params else 900_000),
                    cert_keys=cks,
                    max_sessions=(_nonneg_int(c, "max-sessions")
-                                 if "max-sessions" in c.params else 0))
+                                 if "max-sessions" in c.params else 0),
+                   pool_size=(_nonneg_int(c, "pool-size")
+                              if "pool-size" in c.params else -1))
         lb.start()
         app.tcp_lbs[c.alias] = lb
         return "OK"
@@ -577,6 +580,9 @@ def _h_tl(app: Application, c: Command):
             from ..components.tcplb import MAX_SESSIONS as _def_ms
             ms = _nonneg_int(c, "max-sessions")
             lb.max_sessions = ms if ms > 0 else _def_ms
+        if "pool-size" in c.params:  # hot-set the warm backend pool
+            # (0 = off); existing pools drain and respawn at the new size
+            lb.set_pool_size(_nonneg_int(c, "pool-size"))
         return "OK"
     if c.action in ("remove", "force-remove"):
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")

@@ -62,6 +62,7 @@ def current_config(app: Application) -> str:
         lines.append(f"add cert-key {ck.alias} cert {ck.cert_path} "
                      f"key {ck.key_path}")
     from ..components.tcplb import MAX_SESSIONS as _MAX_SESSIONS
+    from ..components.tcplb import POOL_SIZE as _POOL_SIZE
     for lb in app.tcp_lbs.values():
         secg_part = ("" if lb.security_group.alias == "(allow-all)"
                      else f" security-group {lb.security_group.alias}")
@@ -69,11 +70,14 @@ def current_config(app: Application) -> str:
                    " cert-key " + ",".join(ck.alias for ck in lb.cert_keys))
         ms_part = ("" if lb.max_sessions == _MAX_SESSIONS
                    else f" max-sessions {lb.max_sessions}")
+        pool_part = ("" if lb.pool_size == _POOL_SIZE
+                     else f" pool-size {lb.pool_size}")
         lines.append(
             f"add tcp-lb {lb.alias} address {lb.bind_ip}:{lb.bind_port} "
             f"upstream {lb.backend.alias} protocol {lb.protocol} "
             f"timeout {lb.timeout_ms} "
-            f"in-buffer-size {lb.in_buffer_size}{secg_part}{ck_part}{ms_part}")
+            f"in-buffer-size {lb.in_buffer_size}{secg_part}{ck_part}"
+            f"{ms_part}{pool_part}")
     for s in app.socks5_servers.values():
         flag = " allow-non-backend" if s.allow_non_backend else ""
         secg_part = ("" if s.security_group.alias == "(allow-all)"

@@ -25,6 +25,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/un.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -308,6 +309,16 @@ int vtl_set_nodelay(int fd, int on) {
              ? -errno : 0;
 }
 
+// TCP_DEFER_ACCEPT on a listener: the kernel completes the handshake but
+// only surfaces the connection to accept() once data arrives (or the
+// timeout expires) — empty accepts never wake the accept loop. For
+// client-speaks-first workloads only; a server-first protocol behind a
+// deferred listener waits out `seconds` before its first byte.
+int vtl_set_defer_accept(int fd, int seconds) {
+  return setsockopt(fd, IPPROTO_TCP, TCP_DEFER_ACCEPT, &seconds,
+                    sizeof(seconds)) < 0 ? -errno : 0;
+}
+
 int vtl_sock_name(int fd, int peer, char* ipbuf, int ipbuflen, int* port) {
   sockaddr_storage ss;
   socklen_t len = sizeof(ss);
@@ -360,6 +371,16 @@ struct Pump {
   bool a_eof = false, b_eof = false;       // read side closed
   bool a_wr_shut = false, b_wr_shut = false;
   bool dead = false;
+  // accept fast lane (vtl_pump_connect): B is still mid-connect; the
+  // pump idles until the handshake resolves. A failed connect reports
+  // connect_failed and leaves fd_a OPEN for the python retry layer.
+  // created_us/connect_us let python report the TRUE backend-connect
+  // span (the classic path measures it in on_connected; the fast lane
+  // only hears back at DONE, so the duration rides the stat).
+  bool b_connecting = false;
+  bool connect_failed = false;
+  uint64_t created_us = 0;
+  uint64_t connect_us = (uint64_t)-1;  // -1 = not resolved yet
   int err = 0;
   uint64_t bytes_a2b = 0, bytes_b2a = 0;
   // TLS-terminating pumps (vtl_tls_pump_new): side A is a TLS client
@@ -888,9 +909,44 @@ static void pump_update_interest(Loop* l, Pump* p) {
   if (hb->second->interest != ib) ep_set(l, hb->second, ib);
 }
 
+// connect-failure teardown: like pump_kill but fd_a stays OPEN and
+// unregistered — the python retry layer owns the client fd again and
+// either re-dials another backend or closes it.
+static void pump_fail_connect(Loop* l, Pump* p, int err) {
+  if (p->dead) return;
+  p->dead = true;
+  p->err = err;
+  p->connect_failed = true;
+  for (int fd : {p->fd_a, p->fd_b}) {
+    auto it = l->handlers.find(fd);
+    if (it != l->handlers.end()) {
+      epoll_ctl(l->ep, EPOLL_CTL_DEL, fd, nullptr);
+      drop_handler(l, it->second);
+      l->handlers.erase(it);
+    }
+  }
+  close(p->fd_b);
+  l->done_pumps.push_back(p->id);
+}
+
+static uint64_t mono_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000u + (uint64_t)(ts.tv_nsec / 1000);
+}
+
+static void pump_set_nodelay(int fd_a, int fd_b) {
+  // both sockets, in C: two fewer python->C crossings per session than
+  // the old explicit vtl_set_nodelay pair (non-TCP fds just ENOPROTOOPT)
+  int one = 1;
+  setsockopt(fd_a, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd_b, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
 uint64_t vtl_pump_new(void* lp, int fd_a, int fd_b, int bufsize) {
   Loop* l = (Loop*)lp;
   if (l->handlers.count(fd_a) || l->handlers.count(fd_b)) return 0;
+  pump_set_nodelay(fd_a, fd_b);
   uint64_t id = l->next_pump_id++;
   Pump* p = new Pump(id, fd_a, fd_b, (size_t)bufsize);
   Handler* ha = new Handler{Handler::PUMP_A, id, p, fd_a, (uint32_t)-1};
@@ -906,6 +962,65 @@ uint64_t vtl_pump_new(void* lp, int fd_a, int fd_b, int bufsize) {
   return id;
 }
 
+// The accept fast lane: socket + TCP_NODELAY + nonblocking connect +
+// pump registration in ONE python->C crossing (the python path costs
+// ~8: tcp_connect, epoll add/mod x3, finish_connect, nodelay x2, pump).
+// The pump idles until the connect resolves — the client's early bytes
+// wait in the kernel, exactly like the python path's pause_reading —
+// then splices as if vtl_pump_new had been called. A refused/unreachable
+// backend surfaces as PUMP_DONE with the connect_failed flag
+// (vtl_pump_stat2 out[3] bit0) and fd_a left open for the retry layer.
+uint64_t vtl_pump_connect(void* lp, int fd_a, const char* ip, int port,
+                          int v6, int bufsize) {
+  Loop* l = (Loop*)lp;
+  if (l->handlers.count(fd_a)) return 0;
+  sockaddr_storage ss;
+  socklen_t slen;
+  if (mk_addr(ip, port, v6, &ss, &slen) < 0) return 0;
+  int fd_b = socket(v6 ? AF_INET6 : AF_INET,
+                    SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd_b < 0) return 0;
+  pump_set_nodelay(fd_a, fd_b);
+  int r = connect(fd_b, (sockaddr*)&ss, slen);
+  if (r < 0 && errno != EINPROGRESS) {
+    close(fd_b);
+    return 0;  // sync refusal: caller falls back to the python path
+  }
+  uint64_t id = l->next_pump_id++;
+  Pump* p = new Pump(id, fd_a, fd_b, (size_t)bufsize);
+  p->b_connecting = (r < 0);
+  p->created_us = mono_us();
+  if (!p->b_connecting) p->connect_us = 0;  // resolved synchronously
+  Handler* ha = new Handler{Handler::PUMP_A, id, p, fd_a, (uint32_t)-1};
+  Handler* hb = new Handler{Handler::PUMP_B, id, p, fd_b, (uint32_t)-1};
+  l->handlers[fd_a] = ha;
+  l->handlers[fd_b] = hb;
+  l->valid.insert(ha);
+  l->valid.insert(hb);
+  l->pumps[id] = p;
+  if (p->b_connecting) {
+    ep_set(l, ha, 0);            // quiet until the backend resolves
+    ep_set(l, hb, VTL_EV_WRITE);  // connect completion
+  } else {  // loopback can complete synchronously
+    ep_set(l, ha, VTL_EV_READ);
+    ep_set(l, hb, VTL_EV_READ);
+    pump_run(l, p);
+  }
+  return id;
+}
+
+// connect-timeout hook: if `id` is still mid-connect, fail it like a
+// refused connect (DONE + connect_failed, fd_a kept). No-op otherwise.
+int vtl_pump_abort_connect(void* lp, uint64_t id) {
+  Loop* l = (Loop*)lp;
+  auto it = l->pumps.find(id);
+  if (it == l->pumps.end() || !it->second->b_connecting ||
+      it->second->dead)
+    return 0;
+  pump_fail_connect(l, it->second, ETIMEDOUT);
+  return 1;
+}
+
 // TLS-terminating pump: fd_tls speaks TLS (server role, handshake
 // included — the ClientHello is still queued in the socket thanks to
 // the MSG_PEEK sniffer), fd_plain is the backend. Same id space /
@@ -915,6 +1030,7 @@ uint64_t vtl_tls_pump_new(void* lp, int fd_tls, int fd_plain, int bufsize,
   if (!TLSA.ready || !ctx) return 0;
   Loop* l = (Loop*)lp;
   if (l->handlers.count(fd_tls) || l->handlers.count(fd_plain)) return 0;
+  pump_set_nodelay(fd_tls, fd_plain);
   SSL_* ssl = TLSA.SSL_new((SSL_CTX_*)(intptr_t)ctx);
   if (!ssl) return 0;
   if (TLSA.SSL_set_fd(ssl, fd_tls) != 1) {
@@ -947,6 +1063,23 @@ int vtl_pump_stat(void* lp, uint64_t id, uint64_t* out) {
   out[0] = it->second->bytes_a2b;
   out[1] = it->second->bytes_b2a;
   out[2] = (uint64_t)it->second->err;
+  return 0;
+}
+
+// stat + flags: out[3] bit0 = connect_failed (vtl_pump_connect pumps
+// whose backend never came up — fd_a is still open, python retries),
+// bit1 = still mid-connect; out[4] = resolved backend-connect duration
+// in us (0 when unresolved/unknown — callers gate on the flags)
+int vtl_pump_stat2(void* lp, uint64_t id, uint64_t* out) {
+  Loop* l = (Loop*)lp;
+  auto it = l->pumps.find(id);
+  if (it == l->pumps.end()) return -ENOENT;
+  Pump* p = it->second;
+  out[0] = p->bytes_a2b;
+  out[1] = p->bytes_b2a;
+  out[2] = (uint64_t)p->err;
+  out[3] = (p->connect_failed ? 1u : 0u) | (p->b_connecting ? 2u : 0u);
+  out[4] = p->connect_us == (uint64_t)-1 ? 0 : p->connect_us;
   return 0;
 }
 
@@ -1018,6 +1151,30 @@ int vtl_poll(void* lp, uint64_t* tags, uint32_t* evs, int max,
       case Handler::PUMP_A:
       case Handler::PUMP_B: {
         Pump* p = h->pump;
+        if (h->kind == Handler::PUMP_B && p->b_connecting) {
+          // fast-lane connect resolution: SO_ERROR decides. EPOLLHUP
+          // with SO_ERROR==0 is a SUCCESSFUL connect whose peer already
+          // closed (e.g. a draining backend shedding on accept) — that
+          // must flow as a normal short session (EOF through the pump),
+          // NOT as connect_failed: the python path treats the same
+          // event as connected-then-closed, and a report_failure here
+          // would feed a healthy backend's ejection streak.
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          getsockopt(h->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          if (err) {
+            pump_fail_connect(l, p, err);
+          } else {
+            p->b_connecting = false;
+            p->connect_us = mono_us() - p->created_us;
+            Handler* ha = l->handlers.count(p->fd_a)
+                              ? l->handlers[p->fd_a] : nullptr;
+            if (ha) ep_set(l, ha, VTL_EV_READ);
+            ep_set(l, h, VTL_EV_READ);
+            pump_run(l, p);  // early client bytes may already be queued
+          }
+          break;
+        }
         if (e & EPOLLERR) {
           int err = 0;
           socklen_t elen = sizeof(err);

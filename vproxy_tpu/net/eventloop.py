@@ -102,6 +102,9 @@ class SelectorEventLoop:
         self._handlers: dict[int, tuple[int, Callable]] = {}  # tag -> (fd, cb)
         self._fd_tags: dict[int, int] = {}  # fd -> tag
         self._pump_cbs: dict[int, Callable] = {}  # pump id -> on_done
+        # fast-lane pumps (5-arg DONE contract) -> their connect-deadline
+        # timer (None when timeout_ms=0), cancelled on DONE
+        self._pumpc: dict[int, object] = {}
         self._timers: list[TimerEvent] = []
         self._tick_q: deque = deque()
         self._xq: deque = deque()  # cross-thread queue
@@ -209,6 +212,59 @@ class SelectorEventLoop:
         self._pump_cbs[pid] = on_done
         return pid
 
+    def pump_connect(self, fd_a: int, ip: str, port: int,
+                     bufsize: int = 65536,
+                     on_done: Optional[Callable] = None,
+                     timeout_ms: int = 0,
+                     on_connected: Optional[Callable[[], None]] = None
+                     ) -> int:
+        """Accept fast lane: backend socket + TCP_NODELAY + nonblocking
+        connect + splice registration in ONE native call; the pump idles
+        until the connect resolves. on_done(a2b, b2a, err, flags,
+        connect_us) — flags bit0: the backend never came up and fd_a is
+        STILL OPEN (the caller retries or closes); flags bit1: the pump
+        was torn down while STILL mid-connect (client died first —
+        neither a backend success nor a backend failure); connect_us is
+        the resolved backend-connect duration.
+        Returns 0 when the provider lacks the fast lane (pure-python) or
+        registration failed — callers fall back to Connection.connect.
+        timeout_ms > 0 bounds the connect phase (ETIMEDOUT DONE); at
+        that same deadline, a session that DID connect and is still
+        running gets on_connected() — the bounded-delay substitute for
+        the classic path's on_connected edge (ejection-streak reset for
+        long-lived sessions; short sessions report via on_done)."""
+        fn = getattr(vtl.LIB, "vtl_pump_connect", None)
+        if fn is None or not self._alive():
+            return 0
+        pid = fn(self._lp, fd_a, ip.encode(), port,
+                 1 if ":" in ip else 0, bufsize)
+        if pid == 0:
+            return 0
+        self._pump_cbs[pid] = on_done
+        self._pumpc[pid] = None
+        if timeout_ms > 0:
+            def expire(pid=pid):
+                if not self._alive() or pid not in self._pumpc:
+                    return  # DONE already delivered (timer raced it)
+                # ONE authoritative check at the deadline: abort first
+                # (a pump STILL mid-connect becomes the same
+                # connect_failed DONE a refusal takes, fd_a preserved),
+                # then consult the pump's own flags — never the DONE
+                # queue, which can lag within the same timer batch —
+                # before declaring the connect a success.
+                if vtl.LIB.vtl_pump_abort_connect(self._lp, pid):
+                    return  # timed out: the DONE carries the failure
+                if on_connected is None:
+                    return
+                try:
+                    _, _, _, flags, _ = self._pump_stat2(pid)
+                except OSError:
+                    return  # already freed: on_done handled the outcome
+                if not (flags & 0b11):  # connected, not failed
+                    on_connected()
+            self._pumpc[pid] = self.delay(timeout_ms, expire)
+        return pid
+
     def pump_close(self, pump_id: int) -> None:
         vtl.LIB.vtl_pump_close(self._lp, pump_id)
 
@@ -216,6 +272,18 @@ class SelectorEventLoop:
         out = (ctypes.c_uint64 * 3)()
         vtl.check(vtl.LIB.vtl_pump_stat(self._lp, pump_id, out))
         return int(out[0]), int(out[1]), int(out[2])
+
+    def _pump_stat2(self, pump_id: int):
+        """(a2b, b2a, err, flags, connect_us); flags bit0=connect_failed,
+        bit1=still-connecting (fast-lane pumps only, 0 otherwise)."""
+        fn = getattr(vtl.LIB, "vtl_pump_stat2", None)
+        if fn is None:
+            a2b, b2a, err = self.pump_stat(pump_id)
+            return a2b, b2a, err, 0, 0
+        out = (ctypes.c_uint64 * 5)()
+        vtl.check(fn(self._lp, pump_id, out))
+        return (int(out[0]), int(out[1]), int(out[2]), int(out[3]),
+                int(out[4]))
 
     # ------------------------------------------------------------ timers
 
@@ -317,6 +385,15 @@ class SelectorEventLoop:
             tag, ev = self._tags_buf[i], self._evs_buf[i]
             if ev & vtl.EV_PUMP_DONE:
                 cb = self._pump_cbs.pop(tag, None)
+                if tag in self._pumpc:  # fast-lane pump: 5-arg DONE
+                    t = self._pumpc.pop(tag)
+                    if t is not None:  # connect-deadline timer: dead
+                        t.cancel()     # weight off the timer heap
+                    a2b, b2a, err, flags, cus = self._pump_stat2(tag)
+                    vtl.LIB.vtl_pump_free(self._lp, tag)
+                    if cb is not None:
+                        self._timed(cb, a2b, b2a, err, flags, cus)
+                    continue
                 a2b, b2a, err = self.pump_stat(tag)
                 vtl.LIB.vtl_pump_free(self._lp, tag)
                 if cb is not None:

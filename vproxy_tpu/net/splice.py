@@ -49,8 +49,9 @@ def splice_connect(loop, front_fd: int, ip: str, port: int, head: bytes,
             if conn.detached or conn.closed:
                 return
             bfd = conn.detach()
-            vtl.set_nodelay(front_fd)
-            vtl.set_nodelay(bfd)
+            if not vtl.pump_sets_nodelay():  # pre-r6 .so only
+                vtl.set_nodelay(front_fd)
+                vtl.set_nodelay(bfd)
             loop.pump(front_fd, bfd, 65536, on_done)
 
         def on_closed(self, conn: Connection, err: int) -> None:

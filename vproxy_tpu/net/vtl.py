@@ -68,12 +68,23 @@ def _load() -> ctypes.CDLL:
     lib.vtl_shutdown_wr.argtypes = [c]
     lib.vtl_set_nodelay.argtypes = [c, c]
     lib.vtl_set_rcvbuf.argtypes = [c, c]
+    try:  # absent from a prebuilt pre-defer-accept .so: knob is a no-op
+        lib.vtl_set_defer_accept.argtypes = [c, c]
+    except AttributeError:
+        pass
     lib.vtl_sock_name.argtypes = [c, c, ctypes.c_char_p, c, ctypes.POINTER(c)]
     lib.vtl_pump_new.argtypes = [p, c, c, c]
     lib.vtl_pump_new.restype = u64
     lib.vtl_pump_stat.argtypes = [p, u64, ctypes.POINTER(u64)]
     lib.vtl_pump_close.argtypes = [p, u64]
     lib.vtl_pump_free.argtypes = [p, u64]
+    try:  # accept fast lane (absent from a prebuilt pre-r6 .so)
+        lib.vtl_pump_connect.argtypes = [p, c, ctypes.c_char_p, c, c, c]
+        lib.vtl_pump_connect.restype = u64
+        lib.vtl_pump_abort_connect.argtypes = [p, u64]
+        lib.vtl_pump_stat2.argtypes = [p, u64, ctypes.POINTER(u64)]
+    except AttributeError:
+        pass
     try:  # absent from a prebuilt pre-counters .so: pump_counters()
         lib.vtl_pump_counters.argtypes = [ctypes.POINTER(u64)]
     except AttributeError:  # then reports zeros, everything else works
@@ -121,10 +132,21 @@ def check(r: int) -> int:
     return r
 
 
+# the one parser for the defer-accept knob, shared with the py provider
+from .vtl_py import defer_accept_secs  # noqa: E402
+
+
 def tcp_listen(ip: str, port: int, backlog: int = 512, reuseport: bool = False,
                v6: bool = False) -> int:
-    return check(LIB.vtl_tcp_listen(ip.encode(), port, backlog,
-                                    1 if reuseport else 0, 1 if v6 else 0))
+    fd = check(LIB.vtl_tcp_listen(ip.encode(), port, backlog,
+                                  1 if reuseport else 0, 1 if v6 else 0))
+    secs = defer_accept_secs()
+    if secs > 0:
+        try:
+            LIB.vtl_set_defer_accept(fd, secs)  # best-effort
+        except AttributeError:
+            pass  # prebuilt .so without the symbol
+    return fd
 
 
 def accept(lfd: int):
@@ -232,6 +254,25 @@ if LIB is None:
     for _n in _py.EXPORTS:
         if _n != "LIB":
             globals()[_n] = getattr(_py, _n)
+
+
+# ---------------------------------------------------- pump capabilities
+
+_pump_nodelay_cached: bool = None  # type: ignore[assignment]
+
+
+def pump_sets_nodelay() -> bool:
+    """True when the pump setup applies TCP_NODELAY itself (the r6+
+    native .so via pump_set_nodelay, and the py provider's pump_new).
+    A prebuilt pre-r6 .so does neither — callers must keep setting it
+    explicitly or every spliced session runs with Nagle enabled."""
+    global _pump_nodelay_cached
+    if _pump_nodelay_cached is None:
+        if PROVIDER == "py":
+            _pump_nodelay_cached = True
+        else:
+            _pump_nodelay_cached = hasattr(LIB, "vtl_pump_connect")
+    return _pump_nodelay_cached
 
 
 # -------------------------------------------------------- pump counters

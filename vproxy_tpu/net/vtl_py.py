@@ -48,6 +48,20 @@ def _reg(s: socket.socket) -> int:
     return fd
 
 
+def defer_accept_secs() -> int:
+    """VPROXY_TPU_DEFER_ACCEPT (seconds, 0 = off; read per listen so
+    benches/tests can toggle it at runtime): listeners only surface
+    connections to accept() once the first bytes arrive, so empty
+    accepts never wake the loop. Leave off for server-first protocols —
+    their clients wait for a banner and would stall out the defer
+    window before sending anything. The ONE parser for both providers
+    (vtl.py re-exports it)."""
+    try:
+        return int(os.environ.get("VPROXY_TPU_DEFER_ACCEPT", "0") or "0")
+    except ValueError:
+        return 0
+
+
 def tcp_listen(ip: str, port: int, backlog: int = 512,
                reuseport: bool = False, v6: bool = False) -> int:
     s = socket.socket(socket.AF_INET6 if v6 else socket.AF_INET,
@@ -56,6 +70,9 @@ def tcp_listen(ip: str, port: int, backlog: int = 512,
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if reuseport:
             s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        secs = defer_accept_secs()
+        if secs > 0 and hasattr(socket, "TCP_DEFER_ACCEPT"):
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_DEFER_ACCEPT, secs)
         s.bind((ip, port))
         s.listen(backlog)
     except OSError:
@@ -458,6 +475,17 @@ class _PyLoop:
     def pump_new(self, fd_a: int, fd_b: int, bufsize: int) -> int:
         if fd_a in self.handlers or fd_b in self.handlers:
             return 0
+        # parity with the native pump: NODELAY is the pump's job now
+        # (tcplb._handover no longer sets it) — best-effort, non-TCP
+        # fds (unix pairs) just don't have the option
+        for fd in (fd_a, fd_b):
+            s = _socks.get(fd)
+            if s is not None:
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
         pid = self.next_pump_id
         self.next_pump_id += 1
         p = _Pump(pid, fd_a, fd_b, bufsize)

@@ -24,22 +24,33 @@ Dispatch-path policy (mode = VPROXY_TPU_CLASSIFY, default "auto"):
              benchmarks to force the TPU path end-to-end).
 * "host"   — pure oracle (latency floor; also the correctness baseline).
 
-Latency budget (VPROXY_TPU_CLASSIFY_BUDGET_US, default 5000; 0 = off):
-in "auto" mode a LONE query against a big table normally rides the
-device and eats a full device round trip on the accept path. With a
-budget set, the service tracks per-path EWMA latencies for lone queries
-(device dispatch vs host lookup) and serves lone queries INLINE on the
-submitting thread from the snapshot's O(probes) host index
-(rules/index.py — exact, ~2-10us) when the device round trip exceeds
-the budget: no dispatcher-thread hop, no device RTT, which is what
-makes the BASELINE p99 < 50us accept-path contract meetable even when
-the device sits behind a slow transport. The device EWMA is kept live
-by OFF-PATH probes: every PROBE_EVERY-th rerouted lone query spawns a
-one-shot probe thread that times a synthetic device dispatch, so real
-accept-path queries never eat the probe cost (the round-4 policy rode
-probes on real queries, putting device RTT spikes straight into the
-reported p99). Micro-batches (n >= 2) always ride the device —
-batching is the whole point.
+Inline fast lane (VPROXY_TPU_INLINE_LONE, default on): in "auto" mode
+a LONE query with nothing pending for its matcher is answered INLINE
+on the submitting thread from the snapshot's O(probes) host index
+(rules/index.py — exact, ~2-10us, winner bit-for-bit vs the oracle):
+no dispatcher-thread hop, no device RTT. This is THE accept path —
+accepts consult the host index directly on the accept loop, which is
+what makes the BASELINE p99 < 50us accept-path contract meetable even
+when the device sits behind a slow transport. Micro-batches (n >= 2)
+always ride the device — batching is the whole point, and the device
+stays the bulk path.
+
+With the fast lane disabled (VPROXY_TPU_INLINE_LONE=0) the pre-round-6
+latency-budget policy applies (VPROXY_TPU_CLASSIFY_BUDGET_US, default
+5000; 0 = off): lone big-table queries ride the device while its EWMA
+stays within budget and reroute to the host index once it blows it.
+Either way the device EWMA is kept live by OFF-PATH probes: every
+PROBE_EVERY-th inline-served lone query (rate-limited to one per
+VPROXY_TPU_PROBE_MIN_S seconds) hands the persistent probe worker a
+synthetic device dispatch, so real accept-path queries never eat the
+probe cost (the round-4 policy rode probes on real queries, putting
+device RTT spikes straight into the reported p99). The probe worker is
+deliberately a bad GIL citizen's opposite: it yields between the
+phases of its dispatch and the service shrinks the interpreter's GIL
+slice (VPROXY_TPU_GIL_SLICE_MS, default 1ms vs CPython's 5ms) so a
+probe mid-dispatch can only delay an inline answer by ~one slice —
+this is what kills the ~3ms accept-path p999 spikes the round-5 bench
+saw whenever a probe held the GIL for a full default interval.
 
 Every delivered query also records submit->delivery latency into a
 fixed reservoir; stats.latency_percentiles() surfaces p50/p99 (the
@@ -76,8 +87,29 @@ _log = Logger("classify")
 
 RETRY_S = float(os.environ.get("VPROXY_TPU_DEVICE_RETRY_S", "5"))
 BUDGET_US = float(os.environ.get("VPROXY_TPU_CLASSIFY_BUDGET_US", "5000"))
+INLINE_LONE = os.environ.get("VPROXY_TPU_INLINE_LONE", "1") != "0"
 PROBE_EVERY = 32     # re-probe the non-preferred lone-query path
+PROBE_MIN_S = float(os.environ.get("VPROXY_TPU_PROBE_MIN_S", "0.25"))
+GIL_SLICE_MS = float(os.environ.get("VPROXY_TPU_GIL_SLICE_MS", "1"))
 LAT_RESERVOIR = 4096  # submit->delivery latency samples kept
+
+_gil_slice_applied = False
+
+
+def _apply_gil_slice() -> None:
+    """Shrink the interpreter's thread-switch interval (once, process-
+    wide, never loosening an even smaller configured value): a GIL-
+    holding device probe can then only delay an inline accept-path
+    answer by ~one slice instead of CPython's default 5ms — the source
+    of the round-5 multi-ms p999 spikes."""
+    global _gil_slice_applied
+    if _gil_slice_applied or GIL_SLICE_MS <= 0:
+        return
+    _gil_slice_applied = True
+    import sys
+    want = GIL_SLICE_MS / 1000.0
+    if want < sys.getswitchinterval():
+        sys.setswitchinterval(want)
 
 
 class _Req:
@@ -101,6 +133,7 @@ class ClassifyStats:
         self.failovers = 0        # device errors that degraded a batch
         self.max_batch = 0
         self.budget_reroutes = 0  # lone queries sent to oracle by budget
+        self.inline_fast = 0      # lone queries served by the fast lane
         # counter read-modify-writes go through `lock` (writers are the
         # dispatcher thread AND every inline-answering submit thread)
         self.lock = threading.Lock()
@@ -140,7 +173,7 @@ class ClassifyStats:
     def snapshot(self) -> dict:
         d = {k: getattr(self, k) for k in (
             "queries", "dispatches", "device_queries", "oracle_queries",
-            "failovers", "max_batch", "budget_reroutes")}
+            "failovers", "max_batch", "budget_reroutes", "inline_fast")}
         lat = self.latency_percentiles()
         if lat is not None:
             d["latency_p50_us"] = round(lat["p50_us"], 1)
@@ -171,10 +204,13 @@ class ClassifyService:
         self.mode = mode or os.environ.get("VPROXY_TPU_CLASSIFY", "auto")
         self.retry_s = RETRY_S
         self.budget_us = BUDGET_US
+        self.inline_lone = INLINE_LONE
+        _apply_gil_slice()
         # lone-query EWMA latency (us) per path, None until first sample
         self._ewma = {"device": None, "oracle": None}
         self._elock = threading.Lock()
         self._lone_seen = 0
+        self._probe_last = 0.0  # monotonic ts of the last spawned probe
         # persistent probe worker: the inline accept path only hands it
         # a request + notify (~1us); spawning a Thread per probe costs
         # ~200us and was visible in the accept-path p99
@@ -232,8 +268,12 @@ class ClassifyService:
 
     def _inline_host(self, matcher) -> bool:
         """Lone query, nothing pending for this matcher: answer it
-        synchronously on the submitting thread from the host index when
-        that is the right path — small table (the oracle crossover), the
+        synchronously on the submitting thread from the host index. With
+        the fast lane on (default) this is the first-class path for
+        EVERY lone query in auto mode — the O(probes) index gives the
+        same winner as the oracle at ~us cost, so there is nothing a
+        device round trip could add but latency. With the lane off, the
+        pre-round-6 gates apply: small table (the oracle crossover),
         device marked down, or the budget policy preferring the host.
         Called under the lock; must stay O(1)."""
         if self.mode != "auto":
@@ -243,6 +283,9 @@ class ClassifyService:
         if time.monotonic() < self._device_down_until:
             return True
         if matcher.size() <= SMALL_TABLE:
+            return True
+        if self.inline_lone:
+            self.stats.inline_fast += 1
             return True
         if self.budget_us <= 0:
             return False
@@ -262,7 +305,11 @@ class ClassifyService:
         (the accept path — fully synchronous), else queues it there."""
         t0 = time.monotonic()
         snap = matcher.snapshot()
-        big = matcher.size() > SMALL_TABLE
+        # a host-backend matcher has no device to probe (and its
+        # dispatch_snap is the O(rules) oracle — exactly the GIL-holding
+        # scan the probe worker must never run)
+        big = (matcher.size() > SMALL_TABLE
+               and getattr(matcher, "backend", "host") != "host")
         try:
             if kind == "hint":
                 i = matcher.index_snap(snap, payload)
@@ -284,7 +331,11 @@ class ClassifyService:
             self._note_lone_latency("oracle", dt)
             with self._elock:
                 self._lone_seen += 1
-                probe = self._lone_seen % PROBE_EVERY == 0
+                now = time.monotonic()
+                probe = (self._lone_seen % PROBE_EVERY == 0
+                         and now - self._probe_last >= PROBE_MIN_S)
+                if probe:
+                    self._probe_last = now
             if probe and self.device_ok():
                 self._spawn_probe(kind, matcher, payload)
         i = int(i)
@@ -325,7 +376,13 @@ class ClassifyService:
                     self._probe_cv.wait(1.0)
                 kind, matcher, payload = self._probe_req
             try:
+                # chunked, deliberately-yielding dispatch: the probe is
+                # background work sharing the GIL with the inline accept
+                # path, so it gives the scheduler an explicit preemption
+                # point before each GIL-heavy phase (encode, dispatch)
+                time.sleep(0)
                 snap = matcher.snapshot()
+                time.sleep(0)
                 t0 = time.monotonic()
                 # pad exactly like _device_batch: the probe must time the
                 # SAME compiled program real dispatches run, not trigger

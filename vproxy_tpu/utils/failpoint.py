@@ -13,6 +13,9 @@ operators, via `add fault` / `remove fault` and `GET /faults`) can arm:
                              driving the host-oracle failover path
     hc.force_down            health-check probes report failure
     pump.abort               a just-registered splice pump is killed
+    pool.handover.dead       a validated warm-pool connection dies at
+                             pump handover (the stale-socket race),
+                             driving the fresh-connect fallback
 
 Each armed fault carries three independent gates, all optional:
 
@@ -48,6 +51,7 @@ SITES = (
     "device.dispatch.error",
     "hc.force_down",
     "pump.abort",
+    "pool.handover.dead",
 )
 
 _lock = threading.Lock()
@@ -111,6 +115,15 @@ def active() -> list[dict]:
     """Snapshot for `GET /faults` / `list fault`."""
     with _lock:
         return [f.describe() for f in _registry.values()]
+
+
+def any_armed() -> bool:
+    """Any fault armed at all (the lock-free fast-path gate). The accept
+    fast lane (C-side connect+pump, tcplb._fast_splice) bypasses the
+    python connect path whose code hosts the backend.connect.* sites, so
+    it defers to the classic path whenever faults are armed — failpoint
+    semantics stay exact under test."""
+    return _armed
 
 
 def hit(name: str, ctx: str = "") -> bool:

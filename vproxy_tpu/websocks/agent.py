@@ -667,8 +667,9 @@ class _SocksFront(Handler):
 
             def go(ffd: int) -> None:
                 from ..net import vtl
-                vtl.set_nodelay(ffd)
-                vtl.set_nodelay(pfd)
+                if not vtl.pump_sets_nodelay():  # pre-r6 .so only
+                    vtl.set_nodelay(ffd)
+                    vtl.set_nodelay(pfd)
                 loop.pump(ffd, pfd, 65536, None)
 
             detach_when_drained(self.conn, go)
@@ -748,8 +749,9 @@ class _ConnectFront(Handler):
 
             def go(ffd: int) -> None:
                 from ..net import vtl
-                vtl.set_nodelay(ffd)
-                vtl.set_nodelay(pfd)
+                if not vtl.pump_sets_nodelay():  # pre-r6 .so only
+                    vtl.set_nodelay(ffd)
+                    vtl.set_nodelay(pfd)
                 loop.pump(ffd, pfd, 65536, None)
 
             detach_when_drained(self.conn, go)

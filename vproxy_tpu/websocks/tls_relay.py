@@ -236,8 +236,9 @@ class WebSocksTlsFrontend:
                         return
                     bfd = b.detach()
                     ffd = conn.detach()
-                    vtl.set_nodelay(ffd)
-                    vtl.set_nodelay(bfd)
+                    if not vtl.pump_sets_nodelay():  # pre-r6 .so
+                        vtl.set_nodelay(ffd)
+                        vtl.set_nodelay(bfd)
                     loop.pump(ffd, bfd, 65536, None)
 
                 def on_closed(self, b: Connection, err: int) -> None:
