@@ -1,0 +1,223 @@
+"""Scenario drive for the cluster plane (docs/cluster.md) — the
+round-7 verify flow. Public surfaces only, the way an operator meets
+them:
+
+  1. three nodes booted the production way (VPROXY_TPU_CLUSTER_PEERS +
+     VPROXY_TPU_CLUSTER_SELF -> ClusterNode.boot_from_env), real UDP
+     membership + TCP replication on localhost;
+  2. rules mutated on the LEADER through the command grammar; both
+     followers converge generation + checksum;
+  3. fleet state read back through every operator surface: `list-detail
+     cluster-node`, `GET /cluster` on a real HttpController, a real UDP
+     DNS query for cluster.vproxy.local, and the /metrics text;
+  4. step-synchronized classify traffic on all three nodes (unequal
+     load), then one node killed mid-traffic: survivors degrade through
+     the barrier timeout with zero failed queries; the killed node
+     restarts, re-syncs to the current generation, and the next
+     generation re-joins the whole fleet.
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_cluster.py
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+from vproxy_tpu.cluster import ClusterNode  # noqa: E402
+from vproxy_tpu.control.app import Application  # noqa: E402
+from vproxy_tpu.control.command import Command  # noqa: E402
+from vproxy_tpu.control.http_controller import HttpController  # noqa: E402
+from vproxy_tpu.rules import oracle  # noqa: E402
+from vproxy_tpu.rules.ir import Hint  # noqa: E402
+
+N_RULES = 16
+
+
+def free_port(kind=socket.SOCK_DGRAM):
+    s = socket.socket(socket.AF_INET, kind)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def wait_for(pred, timeout=15.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    assert pred(), f"timeout: {what}"
+
+
+def boot(i, spec):
+    """The production boot path: env vars -> ClusterNode.boot_from_env."""
+    os.environ["VPROXY_TPU_CLUSTER_PEERS"] = spec
+    os.environ["VPROXY_TPU_CLUSTER_SELF"] = str(i)
+    app = Application(workers=1)
+    app.cluster = ClusterNode.boot_from_env(app)
+    assert app.cluster is not None and app.cluster.self_id == i
+    return app, app.cluster
+
+
+def main() -> int:
+    spec = ",".join(
+        f"127.0.0.1:{free_port(socket.SOCK_DGRAM)}"
+        f"/{free_port(socket.SOCK_STREAM)}" for _ in range(3))
+    # fast-converging, test-sized timers; barrier timeout BELOW the
+    # membership down-detection so a kill exercises the degrade edge
+    os.environ["VPROXY_TPU_CLUSTER_HB_MS"] = "0"  # module default wins
+    import vproxy_tpu.cluster.membership as MM
+    import vproxy_tpu.cluster.replicate as RR
+    MM.HB_MS, RR.POLL_MS = 250, 120
+    step_timeout = 500
+
+    apps, nodes = zip(*[boot(i, spec) for i in range(3)])
+    apps, nodes = list(apps), list(nodes)
+    try:
+        # ---- 1. membership converges, node 0 leads
+        wait_for(lambda: all(n.membership.peers_up() == 3 for n in nodes),
+                 what="membership convergence")
+        assert all(n.membership.leader_id() == 0 for n in nodes)
+        print("[1] membership: 3/3 up, leader=0")
+
+        # ---- 2. leader mutations replicate, checksums converge
+        Command.execute(apps[0], "add upstream u0")
+        for i in range(N_RULES):
+            Command.execute(
+                apps[0], f"add server-group g{i} timeout 500 period 60000 "
+                "up 1 down 2 annotations "
+                f'{{"vproxy/hint-host":"s{i}.corp.example"}}')
+            Command.execute(
+                apps[0], f"add server-group g{i} to upstream u0 weight 10")
+        gen = nodes[0].replicator.generation
+        wait_for(lambda: all(n.replicator.generation == gen
+                             for n in nodes), what="replication")
+        sums = {n.replicator.checksum() for n in nodes}
+        assert len(sums) == 1, sums
+        print(f"[2] replication: generation {gen}, one checksum "
+              f"({sums.pop():#010x}) across 3 nodes")
+
+        # ---- 3. every operator read surface agrees
+        detail = Command.execute(apps[1], "list-detail cluster-node")
+        assert any("leader" in ln and ln.startswith("0") for ln in detail)
+        ctl = HttpController(apps[2], "127.0.0.1", 0)
+        ctl.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl.bind_port}/cluster",
+                timeout=5) as r:
+            st = json.loads(r.read())
+        ctl.stop()
+        assert st["enabled"] and st["generation"] == gen \
+            and st["leader"] == 0 and len(st["peers"]) == 3
+        # DNS-as-LB: a real UDP query for the cluster service name
+        from vproxy_tpu.components.elgroup import EventLoopGroup
+        from vproxy_tpu.components.upstream import Upstream
+        from vproxy_tpu.dns import packet as P
+        from vproxy_tpu.dns.server import DNSServer
+        elg = EventLoopGroup("verify-dns", 1)
+        d = DNSServer("d0", elg.next(), "127.0.0.1", 0, Upstream("empty"))
+        d.start()
+        q = P.Packet(id=9, rd=True,
+                     questions=[P.Question("cluster.vproxy.local.", P.A)])
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(3)
+        s.sendto(q.encode(), ("127.0.0.1", d.bind_port))
+        resp = P.parse(s.recvfrom(4096)[0])
+        s.close()
+        d.stop()
+        elg.close()
+        assert len(resp.answers) == 3, resp.answers  # three UP peers
+        from vproxy_tpu.utils.metrics import GlobalInspection
+        text = GlobalInspection.get().prometheus_string()
+        assert "vproxy_cluster_peers_up 3" in text
+        print(f"[3] surfaces: list-detail OK, GET /cluster gen={gen}, "
+              f"DNS A x{len(resp.answers)}, /metrics OK")
+
+        # ---- 4. step traffic, kill node 2 mid-run, degrade, rejoin
+        rules = [h.merged_rule() for h in apps[0].upstreams["u0"].handles]
+        loops = [nodes[i].attach_submit(
+            apps[i].upstreams["u0"]._matcher, step_ms=20, batch_cap=8,
+            timeout_ms=step_timeout) for i in range(3)]
+        # lockstep established: every node sees every peer stepping
+        # (so the kill below is guaranteed to be a barrier break, not
+        # a never-joined peer quietly ignored)
+        wait_for(lambda: all(
+            p.stepping for n in nodes for p in n.membership.peer_list()),
+            what="fleet-wide stepping visibility")
+        lock = threading.Lock()
+        tally = {"ok": 0, "bad": 0}
+
+        def fire(i, n, stride):
+            done = threading.Event()
+            got = []
+            for q in range(n):
+                h = Hint(host=f"s{(q * stride) % (N_RULES + 2)}"
+                         ".corp.example")
+
+                def cb(idx, payload, h=h):
+                    with lock:
+                        tally["ok" if idx == oracle.search(rules, h)
+                              else "bad"] += 1
+                    got.append(1)
+                    if len(got) >= n:
+                        done.set()
+                loops[i].submit(h, cb)
+            return done
+
+        d0 = fire(0, 30, 3)   # busy
+        d1 = fire(1, 5, 5)    # nearly idle
+        assert d0.wait(30) and d1.wait(30)
+        assert tally == {"ok": 35, "bad": 0}, tally
+        assert not any(lp.degraded for lp in loops[:2])
+        # kill node 2 mid-run: queries already queued on survivors
+        d0b = fire(0, 12, 7)
+        nodes[2].close()
+        apps[2].close()
+        assert d0b.wait(30)
+        wait_for(lambda: loops[0].degraded, what="survivor degrade")
+        assert loops[0].barrier_stalls >= 1
+        assert tally == {"ok": 47, "bad": 0}, tally
+        print(f"[4] kill mid-run: {tally['ok']}/47 verdicts correct, "
+              f"survivor degraded after "
+              f"{loops[0].barrier_stalls} stall(s)")
+
+        # restart node 2, re-sync, next generation re-joins the fleet
+        apps[2], nodes[2] = boot(2, spec)
+        wait_for(lambda: all(n.membership.peers_up() == 3 for n in nodes),
+                 what="restart membership")
+        wait_for(lambda: nodes[2].replicator.generation
+                 == nodes[0].replicator.generation, what="restart re-sync")
+        loops[2] = nodes[2].attach_submit(
+            apps[2].upstreams["u0"]._matcher, step_ms=20, batch_cap=8,
+            timeout_ms=step_timeout)
+        Command.execute(apps[0], 'update server-group g0 annotations '
+                        '{"vproxy/hint-host":"swapped.corp.example"}')
+        gen2 = nodes[0].replicator.generation
+        wait_for(lambda: all(n.replicator.generation == gen2
+                             for n in nodes), what="fleet at new gen")
+        wait_for(lambda: not any(lp.degraded for lp in loops),
+                 what="fleet rejoin")
+        assert len({n.replicator.checksum() for n in nodes}) == 1
+        print(f"[5] rejoin: node 2 back at generation {gen2}, "
+              "fleet stepping, checksums equal")
+        print("CLUSTER VERIFY OK")
+        return 0
+    finally:
+        for n in nodes:
+            n.close()
+        for a in apps:
+            a.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -353,6 +353,8 @@ def _child_run():
     # when the tunnel wedges the very next phase forever
     accept_path_section(ph, dl, result)
     flush()
+    cluster_section(ph, result)
+    flush()
 
     ph.start("devices")
     dev = jax.devices()[0]
@@ -769,6 +771,123 @@ def _accept_path_scale(ph, result, detail, n, label, queries) -> None:
         ph.done(**rec)
     finally:
         svc.close()
+
+
+def cluster_section(ph, result) -> None:
+    """Cluster-plane artifact rows (docs/cluster.md), host-only by
+    construction so a wedged tunnel can't cost them:
+
+    * cluster_step_rate — steps/s of a solo StepLoop serving from the
+      host-index path (the degrade lane): the cluster layer's clock +
+      queue + delivery floor, independent of any device.
+    * generation_swap_ms — leader mutation -> follower
+      checksum-verified generation install over real localhost TCP
+      (median of 5), the control-plane convergence latency.
+    """
+    import socket as _s
+    import threading
+
+    ph.start("cluster_step_rate")
+    try:
+        from vproxy_tpu.cluster.submit import StepLoop
+        from vproxy_tpu.rules.engine import HintMatcher
+        from vproxy_tpu.rules.ir import Hint, HintRule
+        rules = [HintRule(host=f"c{i}.cl.bench.example.com")
+                 for i in range(1000)]
+        m = HintMatcher(rules, backend="host")
+        loop = StepLoop(m, None, step_ms=1, batch_cap=16,
+                        timeout_ms=1000)
+        loop.degraded = True  # host-index serving lane, no device
+        loop.start(warm=False)
+        served = [0]
+        stop = threading.Event()
+
+        def feed():
+            cb = (lambda idx, _pl: served.__setitem__(0, served[0] + 1))
+            i = 0
+            while not stop.is_set():
+                loop.submit(Hint(host=f"c{i % 1000}.cl.bench.example.com"),
+                            cb)
+                i += 1
+                if i % 64 == 0:
+                    time.sleep(0.001)
+
+        t = threading.Thread(target=feed, daemon=True)
+        span = 0.7
+        t0 = time.time()
+        t.start()
+        time.sleep(span)
+        stop.set()
+        steps = loop.steps_total
+        dt = time.time() - t0
+        loop.stop()
+        t.join(2)
+        result["cluster_step_rate"] = round(steps / dt, 1)
+        result["cluster_step_queries_s"] = round(served[0] / dt, 1)
+        ph.done(steps_per_s=result["cluster_step_rate"],
+                queries_per_s=result["cluster_step_queries_s"])
+    except MemoryError:
+        raise
+    except Exception as e:  # the artifact survives a section failure
+        result["cluster_step_rate_error"] = repr(e)[:200]
+        ph.done(error=repr(e)[:120])
+
+    ph.start("generation_swap_ms")
+    apps, nodes = [], []
+    try:
+        from vproxy_tpu.cluster import ClusterNode, parse_peers
+        from vproxy_tpu.control.app import Application
+        from vproxy_tpu.control.command import Command
+
+        def free_port(kind):
+            sk = _s.socket(_s.AF_INET, kind)
+            sk.bind(("127.0.0.1", 0))
+            p = sk.getsockname()[1]
+            sk.close()
+            return p
+
+        spec = ",".join(
+            f"127.0.0.1:{free_port(_s.SOCK_DGRAM)}"
+            f"/{free_port(_s.SOCK_STREAM)}" for _ in range(2))
+        for i in (0, 1):
+            app = Application(workers=1)
+            node = ClusterNode(app, i, parse_peers(spec), hb_ms=50,
+                               poll_ms=5000)  # we drive sync_once by hand
+            app.cluster = node
+            node.membership.start()
+            node.replicator.start()
+            apps.append(app)
+            nodes.append(node)
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+                n.membership.peers_up() < 2 for n in nodes):
+            time.sleep(0.02)
+        Command.execute(apps[0], "add upstream u-swap")
+        nodes[1].replicator.sync_once()  # baseline state transferred
+        samples = []
+        for i in range(5):
+            t0 = time.time()
+            Command.execute(
+                apps[0], f"add server-group sw{i} timeout 500 period "
+                "60000 up 1 down 2 annotations "
+                f'{{"vproxy/hint-host":"sw{i}.bench.example"}}')
+            assert nodes[1].replicator.sync_once()
+            samples.append((time.time() - t0) * 1e3)
+            assert (nodes[1].replicator.generation
+                    == nodes[0].replicator.generation)
+        result["generation_swap_ms"] = round(float(np.median(samples)), 2)
+        ph.done(generation_swap_ms=result["generation_swap_ms"],
+                samples=[round(s, 1) for s in samples])
+    except MemoryError:
+        raise
+    except Exception as e:
+        result["generation_swap_ms_error"] = repr(e)[:200]
+        ph.done(error=repr(e)[:120])
+    finally:
+        for n in nodes:
+            n.close()
+        for a in apps:
+            a.close()
 
 
 def service_section(ph, dl):

@@ -47,3 +47,34 @@ def test_chaos_scenario_floor():
     assert report["drain_inflight_alive"], report
     assert report["drain_clean"], report
     assert report["drain_elapsed_s"] < 10.0, report
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_cluster_chaos_kill_and_rejoin():
+    """Cluster-plane chaos (tools/chaos.py run_cluster): 3 localhost
+    nodes, node 2 killed mid-traffic — survivors keep >= 99% classify
+    success through the barrier-timeout degrade, and the restarted node
+    re-joins at the current rule generation."""
+    import chaos
+
+    report = chaos.run_cluster()
+
+    # phase 1: fleet converged, rules replicated, checksums equal
+    assert report["converged"], report
+    assert report["replicated"], report
+    assert report["checksums_equal"], report
+
+    # phase 2: the kill drove the SURVIVORS through the barrier-timeout
+    # degrade — and not one of their queries failed the floor
+    assert report["survivor_success_rate"] >= 0.99, report
+    assert all(report["survivors_degraded"]), report
+    assert all(n >= 1 for n in report["survivor_barrier_stalls"]), report
+
+    # phase 3: node 2 is back, caught up to the CURRENT generation, and
+    # the next generation re-joined every host to step dispatch
+    assert report["rejoin_member"], report
+    assert report["rejoin_caught_up"], report
+    assert report["fleet_at_generation"], report
+    assert report["survivors_rejoined"], report
+    assert report["checksums_equal_after_rejoin"], report

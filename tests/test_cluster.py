@@ -1,0 +1,418 @@
+"""Cluster plane tier-1 tests (vproxy_tpu/cluster): membership edges
+under the cluster.peer.drop failpoint, DNS-as-LB across the fleet,
+rule-generation replication parity (checksum gate, torn transfers),
+the step-synchronized submit loop's stall/degrade/rejoin edges, and
+the operator surface (cluster-node verbs, GET /cluster, metrics)."""
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from vproxy_tpu.cluster import (ClusterNode, Membership, cluster_checksum,
+                                parse_peers)
+from vproxy_tpu.cluster.replicate import Replicator
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import CmdError, Command
+from vproxy_tpu.rules import oracle
+from vproxy_tpu.rules.engine import CidrMatcher, HintMatcher
+from vproxy_tpu.rules.ir import Hint, HintRule
+from vproxy_tpu.utils import failpoint
+from vproxy_tpu.utils.events import FlightRecorder
+
+
+def free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def free_tcp_port() -> int:
+    # replication ports bind TCP — a "free UDP port" says nothing
+    # about the TCP side under a full test run
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def two_node_spec() -> str:
+    return (f"127.0.0.1:{free_udp_port()}/{free_tcp_port()},"
+            f"127.0.0.1:{free_udp_port()}/{free_tcp_port()}")
+
+
+def wait_for(pred, timeout=8.0, step=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failpoint.clear()
+    FlightRecorder.reset()
+    yield
+    failpoint.clear()
+
+
+@pytest.fixture
+def pair():
+    """Two in-process cluster nodes over real localhost UDP/TCP."""
+    spec = two_node_spec()
+    apps = [Application(workers=1), Application(workers=1)]
+    nodes = [ClusterNode(apps[i], i, parse_peers(spec),
+                         hb_ms=50, poll_ms=100) for i in (0, 1)]
+    for a, n in zip(apps, nodes):
+        a.cluster = n
+        n.membership.start()
+        n.replicator.start()
+    yield apps, nodes
+    for n in nodes:
+        n.close()
+    for a in apps:
+        a.close()
+
+
+# ------------------------------------------------------- dist bring-up
+
+def test_init_distributed_unreachable_coordinator_bounded():
+    """init_distributed must not hang forever on an unreachable
+    coordinator: the pre-flight probe raises within the timeout, naming
+    every VPROXY_TPU_DIST_* knob to check (satellite: the old behavior
+    was an unbounded barrier wait)."""
+    from vproxy_tpu.parallel.mesh import init_distributed
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError) as ei:
+        init_distributed(f"127.0.0.1:{dead_port}", num_processes=2,
+                         process_id=1, timeout_s=2)
+    assert time.monotonic() - t0 < 30
+    msg = str(ei.value)
+    for knob in ("VPROXY_TPU_DIST_COORD", "VPROXY_TPU_DIST_NPROC",
+                 "VPROXY_TPU_DIST_PROCID", "VPROXY_TPU_DIST_TIMEOUT_S"):
+        assert knob in msg, msg
+
+
+def test_init_distributed_noop_when_unconfigured(monkeypatch):
+    from vproxy_tpu.parallel.mesh import init_distributed
+    for k in ("VPROXY_TPU_DIST_COORD", "VPROXY_TPU_DIST_NPROC",
+              "VPROXY_TPU_DIST_PROCID"):
+        monkeypatch.delenv(k, raising=False)
+    assert init_distributed() is False
+
+
+# ------------------------------------------------------------- membership
+
+def test_parse_peers_spec():
+    peers = parse_peers("10.0.0.1:7000,10.0.0.2:7000/9100, 10.0.0.3:7002")
+    assert [p.node_id for p in peers] == [0, 1, 2]
+    assert peers[0].repl_port == 7001       # default: heartbeat port + 1
+    assert peers[1].repl_port == 9100       # explicit /replport
+    assert peers[2].addr == ("10.0.0.3", 7002)
+    with pytest.raises(ValueError):
+        parse_peers("no-port")
+
+
+def test_membership_convergence_and_leader(pair):
+    _, nodes = pair
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    assert nodes[0].membership.leader_id() == 0
+    assert nodes[1].membership.leader_id() == 0
+    assert nodes[0].membership.is_leader()
+    assert not nodes[1].membership.is_leader()
+
+
+def test_peer_flap_under_drop_failpoint(pair):
+    """cluster.peer.drop: node 0 stops hearing node 1 -> DOWN after the
+    hysteresis (down_n missed periods), recorder edge; disarm -> peer is
+    re-admitted after up_n good periods; the DNS answer set never goes
+    empty — this node itself is the floor."""
+    _, nodes = pair
+    m0 = nodes[0].membership
+    assert wait_for(lambda: m0.peers_up() == 2)
+    # drop everything node 0 hears from node 1
+    failpoint.arm("cluster.peer.drop", match="from=1")
+    assert wait_for(lambda: m0.peers_up() == 1), \
+        "peer 1 never went DOWN under dropped heartbeats"
+    assert not m0.peers[1].up
+    kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+    assert "peer_down" in kinds
+    # last peer is never evicted from the DNS answers
+    addrs = m0.dns_addrs()
+    assert addrs and all(len(a) == 4 for a in addrs)
+    # recovery: heartbeats flow again -> re-admit through the UP edge
+    failpoint.clear()
+    assert wait_for(lambda: m0.peers_up() == 2), "peer 1 never re-admitted"
+    kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+    assert kinds.count("peer_up") >= 2  # initial UP + re-admission
+
+
+def test_dns_cluster_service_answers_healthy_peers(pair):
+    """`cluster.vproxy.local` A answers = the UP peer set, straight from
+    membership (DNS-as-LB across the fleet), over a real UDP query."""
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.dns.server import DNSServer
+    from test_dns import dns_query
+
+    _, nodes = pair
+    # the DNS hook reads the ClusterNode SINGLETON (the last-created
+    # node): wait until EVERY view converged, not just node 0's
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    elg = EventLoopGroup("dns-cluster", 1)
+    d = DNSServer("d0", elg.next(), "127.0.0.1", 0, Upstream("empty"))
+    d.start()
+    try:
+        resp = dns_query(d.bind_port, "cluster.vproxy.local.")
+        got = sorted(r.rdata for r in resp.answers)
+        assert got == [bytes([127, 0, 0, 1]), bytes([127, 0, 0, 1])], got
+    finally:
+        d.stop()
+        elg.close()
+
+
+# ------------------------------------------------------------ replication
+
+def test_replication_converges_and_checksums_match(pair):
+    apps, nodes = pair
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    Command.execute(apps[0], "add upstream u0")
+    Command.execute(
+        apps[0], "add server-group g0 timeout 500 period 60000 up 1 down 2 "
+        'annotations {"vproxy/hint-host":"a.example.com"}')
+    Command.execute(apps[0], "add server-group g0 to upstream u0 weight 10")
+    gen = nodes[0].replicator.generation
+    assert gen == 3  # one generation per replicated mutation
+    assert wait_for(lambda: nodes[1].replicator.generation == gen), \
+        nodes[1].replicator.status()
+    assert nodes[1].replicator.generation_lag() == 0
+    assert (nodes[0].replicator.checksum()
+            == nodes[1].replicator.checksum())
+    assert list(apps[1].upstreams) == ["u0"]
+    # the follower's ENGINE tables match the leader's (the checksum is
+    # over the published matcher generation, not just the config text)
+    assert (apps[0].upstreams["u0"]._matcher.checksum()
+            == apps[1].upstreams["u0"]._matcher.checksum())
+    # an incremental update replicates too and re-converges
+    Command.execute(
+        apps[0], 'update server-group g0 annotations '
+        '{"vproxy/hint-host":"b.example.com"}')
+    assert wait_for(lambda: nodes[1].replicator.generation == gen + 1)
+    assert (nodes[0].replicator.checksum()
+            == nodes[1].replicator.checksum())
+
+
+def test_follower_rejects_replicated_mutations(pair):
+    """A follower must not silently accept a replicated-type mutation:
+    it would diverge its tables until the next checksum heal tore the
+    mutation (and every live listener) back down. The error names the
+    leader to mutate instead."""
+    apps, nodes = pair
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    with pytest.raises(CmdError, match="follower"):
+        Command.execute(apps[1], "add upstream u-nope")
+    assert "u-nope" not in apps[1].upstreams
+    # non-replicated types stay per-host operable on followers
+    assert Command.execute(apps[1], "list cluster-node") == ["0", "1"]
+
+
+def test_replication_checksum_mismatch_rejects_generation(pair):
+    """A frame whose checksum does not match what the follower builds
+    is REJECTED: the generation stays put, generation_lag > 0, and a
+    generation_reject event lands in the flight recorder."""
+    apps, nodes = pair
+    follower = nodes[1].replicator
+    before = follower.generation
+    ok = follower.apply_frame({"t": "incr", "gen": before + 5,
+                               "cmds": ["add upstream u-bogus"],
+                               "cksum": 0xDEADBEEF})
+    assert not ok
+    assert follower.generation == before
+    assert follower.generation_lag() >= 5
+    assert nodes[1].stat("generation_lag") >= 5
+    kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+    assert "generation_reject" in kinds
+
+
+def test_replication_torn_transfer_never_installs(pair):
+    """cluster.replicate.torn cuts the leader's frame mid-send: the
+    follower rejects it at the framing layer (nothing applied), then
+    converges cleanly once the fault clears."""
+    apps, nodes = pair
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    Command.execute(apps[0], "add upstream u-torn")
+    gen = nodes[0].replicator.generation
+    failpoint.arm("cluster.replicate.torn", count=1)
+    deadline = time.monotonic() + 8
+    torn_seen = False
+    while time.monotonic() < deadline and not torn_seen:
+        kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+        torn_seen = "generation_reject" in kinds
+        time.sleep(0.05)
+    assert torn_seen, "torn transfer never rejected"
+    assert wait_for(lambda: nodes[1].replicator.generation == gen), \
+        "follower never converged after the torn transfer"
+    assert "u-torn" in apps[1].upstreams
+    assert (nodes[0].replicator.checksum()
+            == nodes[1].replicator.checksum())
+
+
+def test_engine_checksums_track_rules():
+    a = HintMatcher([HintRule(host="x.example.com")], backend="host")
+    b = HintMatcher([HintRule(host="x.example.com")], backend="host")
+    assert a.checksum() == b.checksum()
+    b.set_rules([HintRule(host="y.example.com")])
+    assert a.checksum() != b.checksum()
+    from vproxy_tpu.utils.ip import Network
+    ca = CidrMatcher([Network.parse("10.0.0.0/8")], backend="host")
+    cb = CidrMatcher([Network.parse("10.0.0.0/8")], backend="host")
+    assert ca.checksum() == cb.checksum()
+    cb.set_networks([Network.parse("192.168.0.0/16")])
+    assert ca.checksum() != cb.checksum()
+
+
+# --------------------------------------------------------------- step loop
+
+@pytest.fixture
+def solo_node():
+    app = Application(workers=1)
+    spec = f"127.0.0.1:{free_udp_port()}/{free_tcp_port()}"
+    node = ClusterNode(app, 0, parse_peers(spec), hb_ms=50, poll_ms=100)
+    app.cluster = node
+    node.membership.start()
+    node.replicator.start()
+    yield app, node
+    node.close()
+    app.close()
+
+
+def _submit_all(loop, rules, n, stride=3):
+    got, done = [], threading.Event()
+    for q in range(n):
+        h = Hint(host=f"s{(q * stride) % len(rules)}.corp.example")
+
+        def cb(idx, payload, h=h):
+            got.append((h, idx))
+            if len(got) >= n:
+                done.set()
+        loop.submit(h, cb)
+    assert done.wait(30), f"only {len(got)}/{n} step answers arrived"
+    return got
+
+
+def test_step_stall_degrades_to_host_index_and_rejoins(solo_node):
+    """cluster.step.stall wedges a dispatch past the barrier deadline:
+    the host degrades to the inline host-index path (every queued query
+    still answered, oracle parity), advertises the stall in metrics +
+    recorder, and re-joins on the next rule generation."""
+    app, node = solo_node
+    rules = [HintRule(host=f"s{i}.corp.example") for i in range(200)]
+    m = HintMatcher(rules, backend="jax-fp")
+    loop = node.attach_submit(m, step_ms=10, batch_cap=4, timeout_ms=300)
+    failpoint.arm("cluster.step.stall", count=1)
+    got = _submit_all(loop, rules, 6)
+    assert all(idx == oracle.search(rules, h) for h, idx in got)
+    assert loop.degraded and loop.barrier_stalls == 1
+    assert node.stat("barrier_stalls_total") == 1.0
+    assert node.stat("steps_total") >= 1.0
+    kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+    assert "cluster_degrade" in kinds
+    # a new rule generation is the re-join edge
+    Command.execute(app, "add upstream u-rejoin")
+    assert wait_for(lambda: not loop.degraded, timeout=10)
+    assert loop.epoch == node.replicator.generation
+    kinds = [e["kind"] for e in FlightRecorder.get().snapshot()]
+    assert "cluster_rejoin" in kinds
+    # post-rejoin queries ride the device dispatch again, same winners
+    got2 = _submit_all(loop, rules, 4, stride=7)
+    assert all(idx == oracle.search(rules, h) for h, idx in got2)
+    assert not loop.degraded and loop.barrier_stalls == 1
+
+
+def test_step_unequal_load_and_empty_batches(pair):
+    """Two hosts on one step clock with deliberately unequal load: the
+    idle host keeps contributing empty padded batches (steps advance)
+    and both answer oracle-parity verdicts."""
+    apps, nodes = pair
+    assert wait_for(lambda: all(n.membership.peers_up() == 2
+                                for n in nodes))
+    rules = [HintRule(host=f"s{i}.corp.example") for i in range(150)]
+    loops = [n.attach_submit(HintMatcher(rules, backend="jax-fp"),
+                             step_ms=20, batch_cap=8, timeout_ms=2000)
+             for n in nodes]
+    got0 = _submit_all(loops[0], rules, 24)   # busy host
+    got1 = _submit_all(loops[1], rules, 3)    # nearly idle host
+    for got in (got0, got1):
+        assert all(idx == oracle.search(rules, h) for h, idx in got)
+    assert all(not lp.degraded for lp in loops)
+    assert all(lp.steps_total >= 3 for lp in loops)
+
+
+# ------------------------------------------------------- operator surface
+
+def test_cluster_node_verbs_and_http_surface(solo_node):
+    app, node = solo_node
+    assert Command.execute(app, "list cluster-node") == ["0"]
+    port = free_udp_port()
+    assert Command.execute(
+        app, f"add cluster-node 7 address 127.0.0.1:{port}") == "OK"
+    assert Command.execute(app, "list cluster-node") == ["0", "7"]
+    detail = Command.execute(app, "list-detail cluster-node")
+    assert any("self leader" in ln for ln in detail)
+    assert any(ln.startswith("7 ->") and "DOWN" in ln for ln in detail)
+    with pytest.raises(CmdError):
+        Command.execute(app, f"add cluster-node 7 address 127.0.0.1:{port}")
+    with pytest.raises(CmdError):
+        Command.execute(app, "remove cluster-node 0")  # never self
+    assert Command.execute(app, "remove cluster-node 7") == "OK"
+    assert Command.execute(app, "list cluster-node") == ["0"]
+
+    # GET /cluster on the HTTP controller returns the same status view
+    from vproxy_tpu.control.http_controller import HttpController
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl.bind_port}/cluster",
+                timeout=5) as r:
+            st = json.loads(r.read())
+        assert st["enabled"] and st["self"] == 0 and st["is_leader"]
+        assert [p["id"] for p in st["peers"]] == [0]
+    finally:
+        ctl.stop()
+
+
+def test_cluster_node_commands_require_cluster():
+    app = Application(workers=1)
+    try:
+        with pytest.raises(CmdError):
+            Command.execute(app, "list cluster-node")
+    finally:
+        app.close()
+
+
+def test_cluster_metrics_exposed(solo_node):
+    app, node = solo_node
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    text = GlobalInspection.get().prometheus_string()
+    for k in ("vproxy_cluster_peers_up", "vproxy_cluster_generation",
+              "vproxy_cluster_generation_lag", "vproxy_cluster_steps_total",
+              "vproxy_cluster_barrier_stalls_total"):
+        assert k in text, k
+    assert "vproxy_cluster_peers_up 1" in text

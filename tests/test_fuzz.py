@@ -10,6 +10,8 @@ cases reach the deep branches random bytes never hit.
 import os
 import random
 
+import pytest
+
 FUZZ_N = int(os.environ.get("VPROXY_TPU_FUZZ_N", "400"))
 
 from vproxy_tpu.dns import packet as dnsp
@@ -63,6 +65,7 @@ def test_fuzz_ethernet_and_ip_stack():
 
 
 def test_fuzz_vxlan_and_encrypted():
+    pytest.importorskip("cryptography")  # encrypted frames use AES-CFB
     valid = P.Vxlan(7, _valid_eth()).to_bytes()
     for data in corpus(valid):
         must_only_raise(P.Vxlan.parse, data, P.PacketError)

@@ -170,6 +170,7 @@ def test_resp_crud_matrix(resp):
     visibly gone); then replay the saved command log into a FRESH app
     and check the world came back (shutdown persistence contract)."""
     app, c, tmp = resp
+    pytest.importorskip("cryptography")  # the cert-key row needs it
     cert, key = app._matrix_cert
     created = []
     for add, detail_sub, update, remove in MATRIX:
@@ -247,6 +248,10 @@ def test_resp_matrix_covers_creatable_inventory():
         "http-controller", "docker-network-plugin-controller", "tap",
         "xdp", "vlan-adaptor",
         "event-log",  # list-only flight-recorder dump (utils/events)
+        # needs a booted cluster plane (VPROXY_TPU_CLUSTER_PEERS) this
+        # clusterless matrix app doesn't have; the add/remove/list verbs
+        # are exercised end-to-end in tests/test_cluster.py
+        "cluster-node",
     }
     for t in set(TYPES.values()):
         assert t in covered or t in uncreatable, \

@@ -36,6 +36,7 @@ def certs(tmp_path_factory):
 
 
 def test_certkey_sni_choose(certs):
+    pytest.importorskip("cryptography")  # CertKey parses SAN/CN with it
     ck_a = CertKey("a", *certs["a"])
     ck_w = CertKey("w", *certs["w"])
     assert ck_a.dns_names == ["a.example.com"]
@@ -72,6 +73,7 @@ def _tls_get(port, sni, host, path="/"):
 
 
 def test_tls_terminating_lb_routes_by_host(stack, certs):
+    pytest.importorskip("cryptography")  # CertKey parses SAN/CN with it
     sa = IdServer("TA", http=True)
     sb = IdServer("TB", http=True)
     stack["servers"] += [sa, sb]
@@ -103,6 +105,7 @@ def test_tls_terminating_lb_routes_by_host(stack, certs):
 
 
 def test_tls_tcp_mode_uses_sni_as_hint(stack, certs):
+    pytest.importorskip("cryptography")  # CertKey parses SAN/CN with it
     sa = IdServer("RA")  # raw id servers (send id on connect)
     sb = IdServer("RB")
     stack["servers"] += [sa, sb]
@@ -139,6 +142,7 @@ def test_tls_tcp_mode_uses_sni_as_hint(stack, certs):
 
 
 def test_tls_command_grammar(stack, certs, tmp_path):
+    pytest.importorskip("cryptography")  # CertKey parses SAN/CN with it
     from vproxy_tpu.control.app import Application
     from vproxy_tpu.control.command import Command
     from vproxy_tpu.control import persist

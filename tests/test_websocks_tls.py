@@ -29,6 +29,7 @@ SELF_DOMAIN = "ws.example.com"
 @pytest.fixture(scope="module")
 def certs(tmp_path_factory):
     """Self-signed cert for ws.example.com via the cryptography lib."""
+    pytest.importorskip("cryptography")  # optional dep: skip, not error
     from datetime import datetime, timedelta, timezone
 
     from cryptography import x509
@@ -208,6 +209,7 @@ def test_direct_relay_through_websocks(stack):
 
 
 def test_ss_server_end_to_end(stack):
+    pytest.importorskip("cryptography")  # ss ciphers use AES-CFB
     from vproxy_tpu.websocks.ss import CfbStream, SSServer, evp_bytes_to_key
 
     target = IdServer("Z")
@@ -246,6 +248,7 @@ def test_ss_server_end_to_end(stack):
 
 
 def test_ss_domain_addr_and_badtype(stack):
+    pytest.importorskip("cryptography")  # ss ciphers use AES-CFB
     from vproxy_tpu.websocks.ss import CfbStream, SSServer, evp_bytes_to_key
 
     target = IdServer("Y")

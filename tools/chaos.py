@@ -23,6 +23,11 @@ Run standalone (`python tools/chaos.py [--clients N] [--requests N]`)
 for a JSON report, or via `pytest -m chaos` (tests/test_chaos.py
 asserts the success-rate floor and every phase outcome). Kept out of
 tier-1 by the `chaos`/`slow` markers.
+
+`--cluster` runs the CLUSTER-plane scenario instead (run_cluster):
+three localhost nodes, one killed mid-traffic — survivors must keep
+>= 99% classify success through the barrier-timeout degrade, and the
+killed node must re-join at the current rule generation.
 """
 from __future__ import annotations
 
@@ -314,6 +319,179 @@ def run(clients: int = 4, requests: int = 120, payload_len: int = 4096,
     return report
 
 
+# ------------------------------------------------------- cluster scenario
+
+def run_cluster(n_rules: int = 24, queries_per_node: int = 120,
+                log=lambda *_: None) -> dict:
+    """Cluster-plane chaos (vproxy_tpu/cluster): three localhost nodes
+    on real UDP membership + TCP replication + the step-synchronized
+    submit clock. Script:
+
+      1. convergence — 3 nodes up, node 0 leads, leader rules
+         replicate, all checksums equal
+      2. kill        — node 2 dies MID-TRAFFIC. The barrier timeout is
+         set BELOW the membership down-detection, so survivors go
+         through the barrier-timeout degrade edge (host-index serving,
+         no failed query) — the floor is >= 99% classify success on
+         the survivors
+      3. rejoin      — node 2 restarts fresh, re-syncs replication to
+         the CURRENT generation; the next leader mutation moves the
+         fleet to a new generation and every host (survivors included)
+         re-joins step dispatch on it
+    """
+    from vproxy_tpu.cluster import ClusterNode, parse_peers
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+    from vproxy_tpu.rules import oracle
+    from vproxy_tpu.rules.ir import Hint
+
+    def free_port(kind):
+        s = socket.socket(socket.AF_INET, kind)
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_for(pred, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    failpoint.clear()
+    FlightRecorder.reset()
+    report: dict = {}
+    spec = ",".join(
+        f"127.0.0.1:{free_port(socket.SOCK_DGRAM)}"   # heartbeat UDP
+        f"/{free_port(socket.SOCK_STREAM)}"            # replication TCP
+        for _ in range(3))
+    # hb 300ms x down 3 = 900ms down-detection > 400ms barrier timeout:
+    # a killed node hits the barrier-timeout degrade edge, not the
+    # quiet membership eviction
+    HB, POLL, STEP_TO = 300, 120, 400
+
+    def mk_node(i):
+        app = Application(workers=1)
+        node = ClusterNode(app, i, parse_peers(spec), hb_ms=HB,
+                           poll_ms=POLL)
+        app.cluster = node
+        node.membership.start()
+        node.replicator.start()
+        return app, node
+
+    log("phase 1: convergence")
+    apps, nodes = zip(*[mk_node(i) for i in range(3)])
+    apps, nodes = list(apps), list(nodes)
+    try:
+        report["converged"] = wait_for(
+            lambda: all(n.membership.peers_up() == 3 for n in nodes))
+        Command.execute(apps[0], "add upstream u0")
+        for i in range(n_rules):
+            Command.execute(
+                apps[0], f"add server-group g{i} timeout 500 period 60000 "
+                "up 1 down 2 annotations "
+                f'{{"vproxy/hint-host":"s{i}.corp.example"}}')
+            Command.execute(apps[0],
+                            f"add server-group g{i} to upstream u0 weight 10")
+        gen0 = nodes[0].replicator.generation
+        report["replicated"] = wait_for(
+            lambda: all(n.replicator.generation == gen0 for n in nodes))
+        sums = {n.replicator.checksum() for n in nodes}
+        report["checksums_equal"] = len(sums) == 1
+        rules = [h.merged_rule() for h in apps[0].upstreams["u0"].handles]
+
+        loops = [nodes[i].attach_submit(
+            apps[i].upstreams["u0"]._matcher, step_ms=20, batch_cap=8,
+            timeout_ms=STEP_TO) for i in range(3)]
+
+        # traffic: a steady trickle on every node; per-query verdicts
+        # checked against the oracle, 15s delivery deadline
+        lock = threading.Lock()
+        stats = {i: {"ok": 0, "bad": 0, "lost": 0} for i in range(3)}
+        stop_traffic = [threading.Event() for _ in range(3)]
+
+        def traffic(i):
+            pending = []
+            q = 0
+            while q < queries_per_node and not stop_traffic[i].is_set():
+                h = Hint(host=f"s{(q * 7) % (n_rules + 3)}.corp.example")
+                got = {"e": threading.Event(), "idx": None}
+
+                def cb(idx, payload, got=got):
+                    got["idx"] = idx
+                    got["e"].set()
+                try:
+                    loops[i].submit(h, cb)
+                except OSError:
+                    break
+                pending.append((h, got))
+                q += 1
+                time.sleep(0.01)
+            for h, got in pending:
+                if not got["e"].wait(15):
+                    with lock:
+                        stats[i]["lost"] += 1
+                    continue
+                with lock:
+                    key = ("ok" if got["idx"] == oracle.search(rules, h)
+                           else "bad")
+                    stats[i][key] += 1
+
+        threads = [threading.Thread(target=traffic, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+
+        log("phase 2: kill node 2 mid-traffic")
+        time.sleep(0.4)  # mid-traffic, not before it
+        stop_traffic[2].set()
+        nodes[2].close()
+        apps[2].close()
+        for t in threads:
+            t.join(60)
+        report["traffic"] = {str(i): dict(stats[i]) for i in range(3)}
+        surv_ok = stats[0]["ok"] + stats[1]["ok"]
+        surv_all = sum(stats[i][k] for i in (0, 1)
+                       for k in ("ok", "bad", "lost"))
+        report["survivor_success_rate"] = (surv_ok / surv_all
+                                           if surv_all else 0.0)
+        report["survivors_degraded"] = [loops[i].degraded for i in (0, 1)]
+        report["survivor_barrier_stalls"] = [loops[i].barrier_stalls
+                                             for i in (0, 1)]
+
+        log("phase 3: node 2 rejoins at the current generation")
+        apps[2], nodes[2] = mk_node(2)
+        report["rejoin_member"] = wait_for(
+            lambda: all(n.membership.peers_up() == 3 for n in nodes))
+        report["rejoin_caught_up"] = wait_for(
+            lambda: nodes[2].replicator.generation
+            == nodes[0].replicator.generation)
+        # a fresh generation moves the whole fleet (survivors re-join
+        # step dispatch, the restarted node steps with them)
+        loops[2] = nodes[2].attach_submit(
+            apps[2].upstreams["u0"]._matcher, step_ms=20, batch_cap=8,
+            timeout_ms=STEP_TO)
+        Command.execute(apps[0], 'update server-group g0 annotations '
+                        '{"vproxy/hint-host":"swapped.corp.example"}')
+        gen2 = nodes[0].replicator.generation
+        report["rejoin_generation"] = gen2
+        report["fleet_at_generation"] = wait_for(
+            lambda: all(n.replicator.generation == gen2 for n in nodes))
+        report["survivors_rejoined"] = wait_for(
+            lambda: not any(lp.degraded for lp in loops))
+        report["checksums_equal_after_rejoin"] = len(
+            {n.replicator.checksum() for n in nodes}) == 1
+    finally:
+        for n in nodes:
+            n.close()
+        for a in apps:
+            a.close()
+        failpoint.clear()
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--clients", type=int, default=4)
@@ -323,7 +501,19 @@ def main(argv=None) -> int:
     ap.add_argument("--eject-base", type=float, default=0.5,
                     help="eject backoff base seconds (test-sized)")
     ap.add_argument("--drain-s", type=float, default=10.0)
+    ap.add_argument("--cluster", action="store_true",
+                    help="run the cluster-plane scenario instead")
     args = ap.parse_args(argv)
+    if args.cluster:
+        report = run_cluster(
+            log=lambda m: print(f"[chaos] {m}", file=sys.stderr))
+        print(json.dumps(report, indent=2, default=str))
+        floor_ok = report["survivor_success_rate"] >= 0.99
+        print(f"[chaos] survivor success rate "
+              f"{report['survivor_success_rate']:.4f} "
+              f"({'PASS' if floor_ok else 'FAIL'} at 0.99 floor)",
+              file=sys.stderr)
+        return 0 if floor_ok else 1
     report = run(clients=args.clients, requests=args.requests,
                  payload_len=args.payload, eject_base_s=args.eject_base,
                  drain_s=args.drain_s,

@@ -44,6 +44,9 @@ class Application:
         self.docker_controllers: dict[str, object] = {}
         # (switch alias, vni) -> {"ip:port": VpcProxy}
         self.vpc_proxies: dict[tuple, dict] = {}
+        # cluster plane (vproxy_tpu/cluster ClusterNode) — None unless
+        # VPROXY_TPU_CLUSTER_PEERS booted one (main.py)
+        self.cluster = None
         self._resolver = None  # lazy "(default)" resolver
         # fired by request_drain (the `drain` command / SIGTERM path);
         # main.py registers its stop event here
@@ -155,6 +158,9 @@ class Application:
         return cls._instance
 
     def close(self) -> None:
+        if self.cluster is not None:
+            self.cluster.close()
+            self.cluster = None
         for ctl in self.docker_controllers.values():
             ctl.stop()  # unlinks the uds socket file
         for lb in list(self.tcp_lbs.values()) + list(self.socks5_servers.values()):

@@ -69,6 +69,7 @@ TYPES = {
     "docker-network-plugin-controller": "docker-network-plugin-controller",
     "event-log": "event-log", "events": "event-log",
     "fault": "fault", "failpoint": "fault",
+    "cluster-node": "cluster-node", "cn": "cluster-node",
 }
 
 PARAM_KEYS = {
@@ -190,6 +191,30 @@ class Command:
         handler = _HANDLERS.get(c.type)
         if handler is None:
             raise CmdError(f"no handler for resource type {c.type}")
+        # cluster replication hook (cluster/replicate.py): a mutation
+        # against a replicated resource type becomes the next rule
+        # generation on the LEADER. Followers reject it outright —
+        # accepting it would silently diverge their tables until the
+        # next checksum heal tore the mutation (and every live
+        # listener) back down. The mutation lock makes (apply, bump)
+        # atomic against concurrent follower syncs.
+        cluster = getattr(app, "cluster", None)
+        replicated = False
+        if cluster is not None and c.action not in ("list", "list-detail"):
+            from ..cluster.replicate import REPLICATED_TYPES
+            replicated = c.type in REPLICATED_TYPES
+        if replicated:
+            repl = cluster.replicator
+            if not repl._applying and not cluster.membership.is_leader():
+                raise CmdError(
+                    f"this node is a cluster follower; issue mutations "
+                    f"on the leader (node "
+                    f"{cluster.membership.leader_id()}) — followers "
+                    "converge via replication (docs/cluster.md)")
+            with repl.mutation_lock:
+                result = handler(app, c)
+                cluster.on_command(line)
+            return result
         return handler(app, c)
 
 
@@ -1180,6 +1205,56 @@ def _h_fault(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for fault")
 
 
+def _h_cluster(app: Application, c: Command):
+    """`add cluster-node <id> address <ip:port>` admits a peer into the
+    membership view at runtime (the boot set comes from
+    VPROXY_TPU_CLUSTER_PEERS); `remove cluster-node <id>` evicts one;
+    `list[-detail] cluster-node` shows the fleet view (same data as
+    `GET /cluster`)."""
+    cluster = app.cluster
+    if cluster is None:
+        raise CmdError("cluster plane not enabled "
+                       "(set VPROXY_TPU_CLUSTER_PEERS at boot)")
+    if c.action == "add":
+        try:
+            nid = int(c.alias)
+        except ValueError:
+            raise CmdError(f"bad cluster-node id {c.alias!r}")
+        if "address" not in c.params:
+            raise CmdError("cluster-node requires `address <ip:port>`")
+        ip, port = _addr(c.params["address"])
+        try:
+            cluster.membership.add_peer(nid, ip, port)
+        except ValueError as e:
+            raise CmdError(str(e))
+        return "OK"
+    if c.action == "list":
+        return [str(p.node_id) for p in cluster.membership.peer_list()]
+    if c.action == "list-detail":
+        st = cluster.status()
+        out = []
+        for p in cluster.membership.peer_list():
+            role = ("self " if p.node_id == st["self"] else "") + \
+                ("leader" if p.node_id == st["leader"] else "follower")
+            out.append(f"{p.node_id} -> {p.ip}:{p.port} "
+                       f"repl {p.repl_port} "
+                       f"{'UP' if p.up else 'DOWN'} "
+                       f"generation {p.generation} "
+                       f"{'stepping' if p.stepping else 'not-stepping'} "
+                       f"{role}")
+        out.append(f"generation {st['generation']} "
+                   f"lag {st['generation_lag']} "
+                   f"checksum {st['checksum']:#010x}")
+        return out
+    if c.action in ("remove", "force-remove"):
+        try:
+            cluster.membership.remove_peer(int(c.alias))
+        except (ValueError, KeyError) as e:
+            raise CmdError(f"cannot remove cluster-node {c.alias!r}: {e}")
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for cluster-node")
+
+
 def _h_resolver(app: Application, c: Command):
     """The reference's resolver is a singleton named "(default)"
     (ResolverHandle.java:10-16); dns-cache lives inside it."""
@@ -1335,6 +1410,7 @@ def _h_docker(app: Application, c: Command):
 _HANDLERS = {
     "fault": _h_fault,
     "event-log": _h_eventlog,
+    "cluster-node": _h_cluster,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,
     "proxy": _h_proxy,

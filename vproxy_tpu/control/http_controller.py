@@ -143,6 +143,15 @@ class HttpController:
 
         srv.get("/healthz", healthz)
         srv.get("/faults", lambda r: r.resp.end(failpoint.active()))
+
+        def cluster(r: RoutingContext) -> None:
+            # fleet view (cluster plane, docs/cluster.md): membership,
+            # leader, rule generation + lag, step-loop state
+            node = self.app.cluster
+            r.resp.end({"enabled": False} if node is None
+                       else node.status())
+
+        srv.get("/cluster", cluster)
         srv.post("/api/v1/command", self._command)
         srv.all("/api/v1/module/*", self._module)
         srv.listen(self.bind_port, self.bind_ip)

@@ -231,10 +231,18 @@ class DNSServer:
     def _run_internal(self, sub: str, ip: str) -> list[bytes]:
         """`<sub>.vproxy.local` answers (DNSServer.runInternal
         :339-349): who.am.i = the requester's address; who.are.you =
-        this server's local address facing them; anything else consults
-        the control plane's resource resolver."""
+        this server's local address facing them; the cluster service
+        name = the UP cluster peers (DNS-as-LB across the fleet,
+        cluster/membership.py — healthy-only, but never an empty set:
+        this node itself is the floor); anything else consults the
+        control plane's resource resolver."""
         if sub == "who.am.i":
             return [parse_ip(ip)]
+        from ..cluster import cluster_service_name, dns_peer_addrs
+        if sub == cluster_service_name():
+            addrs = dns_peer_addrs()
+            if addrs is not None:
+                return addrs
         if sub == "who.are.you":
             local = self.bind_ip
             if local in ("0.0.0.0", "::"):

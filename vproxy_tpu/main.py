@@ -32,6 +32,19 @@ Env flags (the reference's -D system-property layer, Config.java):
   VPROXY_TPU_DIST_COORD=host:port        jax.distributed coordinator
   VPROXY_TPU_DIST_NPROC=n                ... process count
   VPROXY_TPU_DIST_PROCID=i               ... this process's id
+  VPROXY_TPU_DIST_TIMEOUT_S=s            ... bring-up deadline (120)
+
+Cluster plane (docs/cluster.md):
+  VPROXY_TPU_CLUSTER_PEERS=h:p[/rp],...  fleet topology (node id = index)
+  VPROXY_TPU_CLUSTER_SELF=i              this node's id (default: dist
+                                         process id, else 0)
+  VPROXY_TPU_CLUSTER_HB_MS=ms            membership heartbeat (200)
+  VPROXY_TPU_CLUSTER_UP/_DOWN=n          membership hysteresis (2 / 3)
+  VPROXY_TPU_CLUSTER_POLL_MS=ms          follower replication poll (500)
+  VPROXY_TPU_CLUSTER_SERVICE=name        DNS service sub-domain (cluster)
+  VPROXY_TPU_CLUSTER_STEP_MS=ms          step-clock period (20)
+  VPROXY_TPU_CLUSTER_STEP_TIMEOUT_MS=ms  barrier deadline (1000)
+  VPROXY_TPU_CLUSTER_BATCH=n             per-host rows per step (16)
 
 Failure-containment knobs (docs/robustness.md):
   VPROXY_TPU_CONNECT_RETRIES=n           backend connect retries (default 2)
@@ -164,6 +177,22 @@ def main(argv: list[str] | None = None) -> int:
     elif not opts["no_load"] and os.path.exists(persist.LAST_CONFIG):
         n = persist.load(app)
         print(f"loaded {n} commands from {persist.LAST_CONFIG}")
+
+    # cluster plane AFTER the config load: the leader's journal starts
+    # from the restored resource graph; followers converge onto it via
+    # generation-tagged replication (docs/cluster.md)
+    from .cluster import ClusterNode
+    try:
+        app.cluster = ClusterNode.boot_from_env(app)
+    except (OSError, ValueError) as e:
+        print(f"failed to start cluster plane: {e}", file=sys.stderr)
+        app.close()
+        return 1
+    if app.cluster is not None:
+        m = app.cluster.membership
+        print(f"cluster node {m.self_id}/{len(m.peers)} "
+              f"(heartbeat :{m.peers[m.self_id].port}, replication "
+              f":{app.cluster.replicator.bind_port})")
 
     stop = threading.Event()
     want_drain = threading.Event()  # SIGTERM/`drain`: graceful window

@@ -37,12 +37,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> bool:
+                     process_id: Optional[int] = None,
+                     timeout_s: Optional[float] = None) -> bool:
     """jax.distributed multi-host bring-up; reads VPROXY_TPU_DIST_COORD
     (host:port), VPROXY_TPU_DIST_NPROC, VPROXY_TPU_DIST_PROCID when the
     args are absent. Returns False (no-op) when not configured —
     single-host deployments never pay for it. Must run before the first
-    device use (main.py boots it first thing)."""
+    device use (main.py boots it first thing).
+
+    Bring-up is BOUNDED (VPROXY_TPU_DIST_TIMEOUT_S, default 120s): an
+    unreachable coordinator, a missing peer, or two processes booted
+    with the same VPROXY_TPU_DIST_PROCID would otherwise hang the
+    barrier forever with no hint which knob is wrong. An unreachable
+    coordinator is caught by a bounded pre-flight TCP probe and raises
+    a RuntimeError naming the env vars to check BEFORE entering
+    jaxlib's client (whose own deadline path is a LOG(FATAL) process
+    abort — still bounded by initialization_timeout, just not
+    catchable); other barrier failures surface through
+    initialization_timeout."""
     coordinator = coordinator or os.environ.get("VPROXY_TPU_DIST_COORD")
     if num_processes is None:
         num_processes = int(os.environ.get("VPROXY_TPU_DIST_NPROC", "0")
@@ -52,9 +64,65 @@ def init_distributed(coordinator: Optional[str] = None,
                          or -1)
     if not coordinator or num_processes <= 1 or process_id < 0:
         return False
-    jax.distributed.initialize(coordinator, num_processes=num_processes,
-                               process_id=process_id)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("VPROXY_TPU_DIST_TIMEOUT_S",
+                                         "120"))
+    if process_id > 0:
+        _preflight_coordinator(coordinator, num_processes, process_id,
+                               timeout_s)
+    try:
+        jax.distributed.initialize(
+            coordinator, num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=int(timeout_s))
+    except Exception as e:
+        raise RuntimeError(
+            f"jax.distributed bring-up failed for process "
+            f"{process_id}/{num_processes} against coordinator "
+            f"{coordinator} within {timeout_s:.0f}s: {e!r}. Check "
+            "VPROXY_TPU_DIST_COORD (is the coordinator host:port "
+            "reachable, and running process id 0?), "
+            "VPROXY_TPU_DIST_NPROC (are ALL processes booted?), and "
+            "VPROXY_TPU_DIST_PROCID (ids must be unique in "
+            f"[0, {num_processes})) — a duplicate or missing id leaves "
+            "the bring-up barrier waiting forever; raise "
+            "VPROXY_TPU_DIST_TIMEOUT_S for genuinely slow fleets."
+        ) from e
     return True
+
+
+def _preflight_coordinator(coordinator: str, num_processes: int,
+                           process_id: int, timeout_s: float) -> None:
+    """Bounded TCP probe of the coordinator before handing control to
+    jaxlib: its deadline path aborts the process (LOG(FATAL)), so the
+    by-far-most-common misconfiguration — coordinator address wrong or
+    process 0 not up — must fail as a catchable error here instead."""
+    import socket
+    import time
+    host, _, port = coordinator.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    deadline = time.monotonic() + timeout_s
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(
+                (host, int(port)),
+                timeout=max(0.5, min(5.0, deadline - time.monotonic()))
+            ).close()
+            return
+        except OSError as e:
+            last = e
+            time.sleep(min(1.0, max(0.05, deadline - time.monotonic())))
+    raise RuntimeError(
+        f"jax.distributed coordinator {coordinator} unreachable after "
+        f"{timeout_s:.0f}s (process {process_id}/{num_processes}): "
+        f"{last!r}. Check VPROXY_TPU_DIST_COORD (must be the host:port "
+        "where the VPROXY_TPU_DIST_PROCID=0 process runs, and that "
+        "process must be up first), VPROXY_TPU_DIST_NPROC, and that "
+        "every process has a unique VPROXY_TPU_DIST_PROCID in "
+        f"[0, {num_processes}); raise VPROXY_TPU_DIST_TIMEOUT_S for "
+        "genuinely slow fleets.")
 
 
 def make_mesh(n_devices: Optional[int] = None, batch: int = 1,

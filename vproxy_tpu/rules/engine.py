@@ -124,6 +124,7 @@ class HintMatcher:
         # budget policy answers lone queries from (rules/index.py)
         self._pub: tuple = (None, None, [], payload, None)
         self._payload = payload
+        self._cksum = None  # (pub-tuple, crc32) cache — see checksum()
         self._recompile()
 
     @property
@@ -220,6 +221,24 @@ class HintMatcher:
     def size(self) -> int:
         return len(self._pub[2])
 
+    def checksum(self) -> int:
+        """u32 checksum of the PUBLISHED rule generation (crc32 over the
+        canonical rule reprs): two hosts whose tables compiled from the
+        same rule list hash identically regardless of caps-growth
+        history. The cluster replication gate (cluster/replicate.py)
+        compares this across hosts before installing a generation.
+        Computed once per generation (cached at publish): replication
+        polls read it every few hundred ms and must not pay an O(rules)
+        string build each time."""
+        pub = self._pub
+        cached = self._cksum
+        if cached is not None and cached[0] is pub:
+            return cached[1]
+        import zlib
+        v = zlib.crc32("\n".join(map(repr, pub[2])).encode())
+        self._cksum = (pub, v)
+        return v
+
     def snapshot(self) -> tuple:
         """One consistent (table, device, rules, payload) generation."""
         return self._pub
@@ -309,6 +328,7 @@ class CidrMatcher:
         # (see HintMatcher._pub for the why)
         self._pub: tuple = (None, [], None, payload, None, None)
         self._payload = payload
+        self._cksum = None  # (pub-tuple, crc32) cache — see checksum()
         self._recompile()
 
     def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
@@ -392,6 +412,22 @@ class CidrMatcher:
 
     def size(self) -> int:
         return len(self._pub[1])
+
+    def checksum(self) -> int:
+        """u32 checksum of the published networks+ACL generation (see
+        HintMatcher.checksum — the cluster replication gate; cached per
+        published generation)."""
+        snap = self._pub
+        cached = self._cksum
+        if cached is not None and cached[0] is snap:
+            return cached[1]
+        import zlib
+        text = "\n".join(map(repr, snap[1]))
+        if snap[2] is not None:
+            text += "\n" + "\n".join(map(repr, snap[2]))
+        v = zlib.crc32(text.encode())
+        self._cksum = (snap, v)
+        return v
 
     def snapshot(self) -> tuple:
         """One consistent (device, nets, acl, payload) generation."""

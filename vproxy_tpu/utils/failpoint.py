@@ -16,6 +16,15 @@ operators, via `add fault` / `remove fault` and `GET /faults`) can arm:
     pool.handover.dead       a validated warm-pool connection dies at
                              pump handover (the stale-socket race),
                              driving the fresh-connect fallback
+    cluster.peer.drop        inbound membership heartbeats are dropped
+                             (ctx "from=<id> <addr>"), driving the
+                             peer-DOWN hysteresis edge
+    cluster.replicate.torn   the leader cuts a replication frame
+                             mid-transfer; followers must reject it at
+                             the framing layer (no partial install)
+    cluster.step.stall       a step dispatch wedges past the barrier
+                             deadline, degrading the host to the
+                             inline host-index path
 
 Each armed fault carries three independent gates, all optional:
 
@@ -52,6 +61,9 @@ SITES = (
     "hc.force_down",
     "pump.abort",
     "pool.handover.dead",
+    "cluster.peer.drop",
+    "cluster.replicate.torn",
+    "cluster.step.stall",
 )
 
 _lock = threading.Lock()

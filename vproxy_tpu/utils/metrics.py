@@ -275,6 +275,13 @@ class GlobalInspection:
                                "tls_handshakes")):
             self.registry.gauge_f(f"vproxy_pump_{k}_total",
                                   lambda i=i: self._pump_counter(i))
+        # cluster plane (vproxy_tpu/cluster): fleet membership, rule
+        # generation convergence, and the step-synchronized dispatch
+        # clock — all 0 until a ClusterNode boots
+        for k in ("peers_up", "generation", "generation_lag",
+                  "steps_total", "barrier_stalls_total"):
+            self.registry.gauge_f(
+                f"vproxy_cluster_{k}", lambda k=k: self._cluster_stat(k))
         # event-loop health: worst timer slip and longest single callback
         # across all live loops since the previous scrape (the known
         # GIL-contention p999 culprits); reading resets the window
@@ -288,6 +295,12 @@ class GlobalInspection:
         from ..rules.service import ClassifyService
         svc = ClassifyService._instance
         return 0.0 if svc is None else float(getattr(svc.stats, key))
+
+    @staticmethod
+    def _cluster_stat(key: str) -> float:
+        from ..cluster import ClusterNode
+        node = ClusterNode._instance
+        return 0.0 if node is None else node.stat(key)
 
     @staticmethod
     def _pump_counter(i: int) -> float:
@@ -451,6 +464,13 @@ def launch_inspection_http(loop, ip: str, port: int):
 
     srv.get("/events", events)
     srv.get("/faults", lambda ctx: ctx.resp.end(failpoint.active()))
+
+    def cluster(ctx) -> None:
+        from ..cluster import ClusterNode
+        node = ClusterNode._instance
+        ctx.resp.end({"enabled": False} if node is None else node.status())
+
+    srv.get("/cluster", cluster)
 
     def healthz(ctx) -> None:
         # draining flips to 503 so upstream LB health probes steer away
