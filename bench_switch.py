@@ -158,12 +158,17 @@ def replay(loop, sw, counter, dgrams, secs):
     return n_in, counter.sent, dt
 
 
-def socket_pipeline(loop, sw, dgrams, secs):
+def socket_pipeline(loop, sw, dgrams, secs, flowcache=False):
     """Blast the replay set at the switch's REAL UDP socket and count
     egressed datagrams at a receiver socket (both sides mmsg-batched).
     The blaster + receiver run in a SUBPROCESS so the generator never
     steals the switch loop's GIL. UDP drops under pressure are expected
-    — the receiver count is the honest delivered rate."""
+    — the receiver count is the honest delivered rate.
+
+    flowcache toggles the native flow-cache forwarding loop for a
+    same-run A/B (PERF_NOTES: never compare across sessions): with it
+    on, repeat-flow datagrams forward inside C and the egress count is
+    python-side sends + the native fwd counter delta."""
     import subprocess
     import tempfile
 
@@ -172,6 +177,9 @@ def socket_pipeline(loop, sw, dgrams, secs):
 
     if vtl.PROVIDER != "native":
         return None
+    if flowcache and not vtl.flowcache_supported():
+        return None
+    loop.call_sync(lambda: sw.set_flowcache(flowcache), timeout=30)
     with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
         for d, _, _ in dgrams:
             f.write(len(d).to_bytes(4, "little") + d)
@@ -210,14 +218,37 @@ def socket_pipeline(loop, sw, dgrams, secs):
         loop.call_sync(repoint, timeout=30)
         child.stdin.write("go\n")
         child.stdin.flush()
-        out, _ = child.communicate(timeout=secs + 60)
+        child.stdout.readline()  # "warmed": learning/installs settled
+        # quiesce: the warmup can leave megabytes of rcvbuf backlog —
+        # wait until the switch stops egressing before snapshotting, or
+        # the measured window starts with a head start
+        last, t_q = -1, time.perf_counter()
+        while time.perf_counter() - t_q < 8.0:
+            cur = CountingBare.egressed + vtl.flowcache_counters()[4]
+            if cur == last:
+                break
+            last = cur
+            time.sleep(0.3)
+        CountingBare.egressed = 0  # count the measured window only
+        fc0 = vtl.flowcache_counters()
+        child.stdin.write("run\n")
+        child.stdin.flush()
+        out, _ = child.communicate(timeout=2 * secs + 60)
         r = json.loads(out.strip().splitlines()[-1])
-        return {"switch_socket_sent": r["sent"],
-                "switch_socket_egressed": CountingBare.egressed,
-                "switch_socket_rx": r["rx"],
-                "switch_socket_loopback_pps": round(
-                    CountingBare.egressed / r["secs"], 1),
-                "switch_socket_sent_pps": r["sent_pps"]}
+        fc1 = vtl.flowcache_counters()
+        native_fwd = fc1[4] - fc0[4]
+        egressed = CountingBare.egressed + native_fwd
+        res = {"switch_socket_sent": r["sent"],
+               "switch_socket_egressed": egressed,
+               "switch_socket_native_fwd": native_fwd,
+               "switch_socket_rx": r["rx"],
+               "switch_socket_loopback_pps": round(egressed / r["secs"], 1),
+               "switch_socket_sent_pps": r["sent_pps"]}
+        probes = (fc1[0] - fc0[0]) + (fc1[1] - fc0[1])
+        if flowcache and probes:
+            res["switch_flowcache_hit_rate"] = round(
+                (fc1[0] - fc0[0]) / probes, 4)
+        return res
     finally:
         if child is not None and child.poll() is None:
             child.kill()  # error paths must not orphan the blaster
@@ -232,7 +263,10 @@ def socket_pipeline(loop, sw, dgrams, secs):
 
 
 def blast_main(switch_port: int, secs: float, corpus: str) -> int:
-    """--blast child: receiver + sendmmsg generator (own process)."""
+    """--blast child: receiver + sendmmsg generator (own process).
+    SWBENCH_BLAST_THREADS (3) parallel senders, each with its own tx
+    socket — ctypes releases the GIL during sendmmsg, so the generator
+    can outrun a multiqueue switch instead of being the bottleneck."""
     import threading
 
     from vproxy_tpu.net import vtl
@@ -245,41 +279,101 @@ def blast_main(switch_port: int, secs: float, corpus: str) -> int:
         ln = int.from_bytes(raw[o: o + 4], "little")
         datas.append(raw[o + 4: o + 4 + ln])
         o += 4 + ln
-    rx = vtl.udp_bind("127.0.0.1", 0)
-    _, rport = vtl.sock_name(rx)
-    vtl.set_rcvbuf(rx, 8 << 20)
+    # reuseport-sharded receiver: the switch's pollers egress from
+    # distinct sockets, so the kernel spreads their deliveries across
+    # these — one receiver socket's lock would serialize the whole
+    # multiqueue egress side
+    rxs = [vtl.udp_bind("127.0.0.1", 0, reuseport=True)]
+    _, rport = vtl.sock_name(rxs[0])
+    for _ in range(2):
+        rxs.append(vtl.udp_bind("127.0.0.1", rport, reuseport=True))
+    for rx in rxs:
+        vtl.set_rcvbuf(rx, 16 << 20)
     print(json.dumps({"rx_port": rport}), flush=True)
     sys.stdin.readline()  # wait for the parent's "go"
     stop = [False]
     rx_count = [0]
+    rx_lock = threading.Lock()
 
-    def drain():
+    def drain(rx):
         while not stop[0]:
             got = vtl.recvmmsg(rx)
             if not got:
                 time.sleep(0.0005)
                 continue
-            rx_count[0] += len(got)
+            with rx_lock:
+                rx_count[0] += len(got)
 
-    th = threading.Thread(target=drain, daemon=True)
-    th.start()
-    tx = vtl.udp_socket()
-    sent = 0
-    t0 = time.perf_counter()
-    deadline = t0 + secs
-    while time.perf_counter() < deadline:
-        for i in range(0, len(datas), 128):
-            n = vtl.sendmmsg(tx, datas[i: i + 128], "127.0.0.1",
-                             switch_port)
-            sent += n
-            if n < min(128, len(datas) - i):
-                time.sleep(0.0002)  # switch rcvbuf full: brief backoff
-    dt = time.perf_counter() - t0  # send window only (honest sent_pps)
-    time.sleep(0.3)  # pipeline flush (egress/rx counters keep counting)
+    drains = [threading.Thread(target=drain, args=(rx,), daemon=True)
+              for rx in rxs]
+    for th in drains:
+        th.start()
+    nsend = _env_int("SWBENCH_BLAST_THREADS", 3)
+    sent = [0] * nsend
+
+    def _rekey(d: bytes, k: int) -> bytes:
+        """Thread k impersonates a DISTINCT host set: bump the src mac
+        and src-ip octet (+ checksum recompute). Without this the same
+        src mac/ip arrives from k different sender sockets and the
+        mac/arp tables flap between ifaces on every packet — a learn
+        storm no real deployment produces."""
+        if k == 0 or len(d) < 42 or d[20] != 8 or d[21] != 0 \
+                or d[22] != 0x45:
+            return d
+        b = bytearray(d)
+        b[19] = (b[19] + k) & 0xFF   # src mac last byte
+        b[35] = (b[35] + k) & 0xFF   # src ip second octet
+        b[32] = b[33] = 0
+        s = 0
+        for o in range(22, 42, 2):
+            s += (b[o] << 8) | b[o + 1]
+        s = (s & 0xFFFF) + (s >> 16)
+        s = (s & 0xFFFF) + (s >> 16)
+        c = s ^ 0xFFFF
+        b[32], b[33] = c >> 8, c & 0xFF
+        return bytes(b)
+
+    per_thread = [[_rekey(d, k) for d in datas] for k in range(nsend)]
+    txs = [vtl.udp_socket() for _ in range(nsend)]
+
+    def send_until(k: int, deadline: float) -> None:
+        mine, tx = per_thread[k], txs[k]
+        while time.perf_counter() < deadline:
+            for i in range(0, len(mine), 128):
+                n = vtl.sendmmsg(tx, mine[i: i + 128], "127.0.0.1",
+                                 switch_port)
+                sent[k] += n
+                if n < min(128, len(mine) - i):
+                    time.sleep(0.0002)  # switch rcvbuf full: backoff
+
+    def blast(window: float) -> float:
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=send_until,
+                                args=(k, t0 + window), daemon=True)
+               for k in range(nsend)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        return time.perf_counter() - t0
+
+    # warmup: learns settle, flow entries compile — the measured window
+    # is steady state for BOTH arms (same replay-stage methodology)
+    blast(float(os.environ.get("SWBENCH_SOCK_WARMUP", "1.0")))
+    time.sleep(0.2)  # in-flight flush before the parent snapshots
+    sent = [0] * nsend
+    with rx_lock:
+        rx_count[0] = 0
+    print(json.dumps({"warmed": 1}), flush=True)
+    sys.stdin.readline()  # parent snapshotted its counters: measure
+    dt = blast(secs)  # send window only (honest sent_pps)
+    time.sleep(0.5)  # pipeline flush (egress/rx counters keep counting)
     stop[0] = True
-    th.join(2)
-    print(json.dumps({"sent": sent, "rx": rx_count[0], "secs": dt,
-                      "sent_pps": round(sent / dt, 1),
+    for th in drains:
+        th.join(2)
+    total = sum(sent)
+    print(json.dumps({"sent": total, "rx": rx_count[0], "secs": dt,
+                      "sent_pps": round(total / dt, 1),
                       "rx_pps": round(rx_count[0] / dt, 1)}), flush=True)
     return 0
 
@@ -298,10 +392,20 @@ def main():
             os.replace(out_path + ".tmp", out_path)
 
     loops = []
+    # multiqueue pollers for the flowcache arm (SWBENCH_POLLERS extra
+    # REUSEPORT lanes; the noflowcache arm stops them, so its traffic
+    # all rehashes to the main socket — same-run, same blaster)
+    os.environ.setdefault("VPROXY_TPU_SWITCH_POLLERS",
+                          os.environ.get("SWBENCH_POLLERS", "4"))
+    result["switch_pollers"] = int(os.environ["VPROXY_TPU_SWITCH_POLLERS"])
     try:
         t_build = time.time()
         loop, sw, counter, dgrams = build_world(backend=None)
         loops.append((loop, sw))
+        # the replay stage drives _input_batch directly (no socket), so
+        # the flow cache can't serve it — disable so the entry compiler
+        # doesn't charge the replay metric for installs it never uses
+        loop.call_sync(lambda: sw.set_flowcache(False), timeout=30)
         result["switch_build_s"] = round(time.time() - t_build, 2)
         result["switch_routes"] = _env_int("SWBENCH_ROUTES", 50_000)
         result["switch_acls"] = _env_int("SWBENCH_ACLS", 5_000)
@@ -315,11 +419,23 @@ def main():
         result["switch_replay_secs"] = round(dt, 2)
         flush()
 
-        sock = socket_pipeline(loop, sw, dgrams,
-                               float(os.environ.get("SWBENCH_SOCK_SECS",
-                                                    "4")))
-        if sock:
-            result.update(sock)
+        # full socket pipeline, same-run A/B: flow cache OFF (the python
+        # burst path) then ON (the native forwarding loop). The headline
+        # switch_socket_* rows are the flowcache arm when available.
+        sock_secs = float(os.environ.get("SWBENCH_SOCK_SECS", "4"))
+        sock_off = socket_pipeline(loop, sw, dgrams, sock_secs,
+                                   flowcache=False)
+        if sock_off:
+            result["switch_socket_loopback_pps_noflowcache"] = \
+                sock_off["switch_socket_loopback_pps"]
+            result.update(sock_off)
+            flush()
+        sock_on = socket_pipeline(loop, sw, dgrams, sock_secs,
+                                  flowcache=True)
+        if sock_on:
+            result["switch_socket_loopback_pps_flowcache"] = \
+                sock_on["switch_socket_loopback_pps"]
+            result.update(sock_on)  # headline rows = flowcache arm
             flush()
 
         # /metrics snapshot: the per-reason drop/forward counters the
@@ -342,6 +458,7 @@ def main():
         # reference-style per-packet linear scan for context
         loop2, sw2, counter2, dgrams2 = build_world(backend="host")
         loops.append((loop2, sw2))
+        loop2.call_sync(lambda: sw2.set_flowcache(False), timeout=30)
         n_in2, n_out2, dt2 = replay(loop2, sw2, counter2, dgrams2,
                                     oracle_secs)
         result["switch_replay_pps_oracle"] = round(n_in2 / dt2, 1)

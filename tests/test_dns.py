@@ -113,6 +113,41 @@ def test_dns_server_lb_answers(dns_stack):
     assert resp.rcode == 3
 
 
+def test_dns_answer_cache_and_health_invalidation(dns_stack):
+    """Repeat queries serve from the packed-answer cache; a backend
+    health edge invalidates instantly (never an answer past its DOWN
+    edge); distinct query ids get the cached bytes re-stamped."""
+    elg = dns_stack["elg"]
+    s1, s2 = IdServer("A"), IdServer("B")
+    dns_stack["servers"] += [s1, s2]
+    g = ServerGroup("g", elg, fast_hc(), "wrr")
+    dns_stack["groups"].append(g)
+    g.add("a", "127.0.0.1", s1.port)
+    g.add("b", "127.0.0.1", s2.port)
+    wait_healthy(g, 2)
+    rr = Upstream("rr")
+    rr.add(g, annotations=HintRule(host="svc.corp.local"))
+    d = DNSServer("dnsc", elg.next(), "127.0.0.1", 0, rr)
+    dns_stack["dns"].append(d)
+    d.start()
+
+    r1 = dns_query(d.bind_port, "svc.corp.local.", P.SRV)
+    assert sorted(rec.rdata[2] for rec in r1.answers) == \
+        sorted([s1.port, s2.port])
+    hits0 = d.cache_hits
+    r2 = dns_query(d.bind_port, "svc.corp.local.", P.SRV)
+    assert d.cache_hits == hits0 + 1  # served from the packed cache
+    assert r2.id == 99 and len(r2.answers) == len(r1.answers)
+    # health edge: kill one backend -> cached answer must die with it
+    s1.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and sum(s.healthy for s in g.servers) > 1:
+        time.sleep(0.05)
+    assert sum(s.healthy for s in g.servers) == 1
+    r3 = dns_query(d.bind_port, "svc.corp.local.", P.SRV)
+    assert [rec.rdata[2] for rec in r3.answers] == [s2.port]
+
+
 def test_dns_recursion_via_fake_upstream(dns_stack):
     elg = dns_stack["elg"]
     # fake upstream DNS: answers everything with 7.7.7.7

@@ -1,0 +1,52 @@
+"""Native-build guard: a committed libvtl.so must never drift from
+vtl.cpp.
+
+Rebuilds via native/Makefile when the source is newer than the .so
+(make's own staleness rule), then asserts the freshly-built library
+exports the current ABI surface — including the flow-cache symbols —
+and that the C install-record size matches the Python struct packing
+bit for bit. Catches the "stale committed .so" failure mode where the
+pure-Python fallback (or an AttributeError at ctypes bind time) would
+otherwise silently disable whole subsystems.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "vproxy_tpu",
+                          "native")
+SO = os.path.join(NATIVE_DIR, "libvtl.so")
+
+REQUIRED_SYMBOLS = (
+    # event loop + sockets + pump (the pre-existing surface)
+    "vtl_new", "vtl_poll", "vtl_free", "vtl_pump_new", "vtl_pump_connect",
+    "vtl_pump_counters", "vtl_recvmmsg", "vtl_sendmmsg",
+    # switch flow cache (this PR's surface)
+    "vtl_flowcache_new", "vtl_flowcache_free", "vtl_switch_gen_bump",
+    "vtl_switch_gen", "vtl_switch_poll", "vtl_flow_install",
+    "vtl_flowcache_counters", "vtl_flowcache_stat", "vtl_flow_rec_size",
+    "vtl_wait_readable",
+)
+
+
+def test_native_so_rebuilds_and_exports_current_abi():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        if not os.path.exists(SO):
+            pytest.skip("no toolchain and no prebuilt libvtl.so")
+    else:
+        r = subprocess.run(["make", "-s"], cwd=NATIVE_DIR,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, f"native build failed: {r.stderr[:500]}"
+        src = os.path.join(NATIVE_DIR, "vtl.cpp")
+        assert os.path.getmtime(SO) >= os.path.getmtime(src), \
+            "make left libvtl.so older than vtl.cpp"
+    lib = ctypes.CDLL(SO)
+    missing = [s for s in REQUIRED_SYMBOLS if not hasattr(lib, s)]
+    assert not missing, f"libvtl.so lacks symbols: {missing}"
+    from vproxy_tpu.net import vtl
+    assert int(lib.vtl_flow_rec_size()) == vtl.FLOW_REC.size, \
+        "C FlowRec layout drifted from net/vtl.py FLOW_REC"
+    assert len(vtl.flowcache_counters()) == 5 + len(vtl.FLOW_DROP_REASONS)

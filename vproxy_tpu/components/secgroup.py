@@ -28,6 +28,23 @@ class SecurityGroup:
         # plane allow() never sees a half-updated table/rule-list pair
         self._tables: dict[Proto, tuple[CidrMatcher, list[AclRule]]] = {}
         self._lock = threading.Lock()
+        # mutation listeners (fired AFTER the new table publishes, lock
+        # released): the switch flow cache registers its generation bump
+        # here so an ACL edit invalidates native entries immediately
+        self._listeners: list = []
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def _fire(self) -> None:
+        for cb in list(self._listeners):
+            cb()
 
     @classmethod
     def allow_all(cls) -> "SecurityGroup":
@@ -47,6 +64,7 @@ class SecurityGroup:
                     raise ValueError(f"equivalent rule {r.alias} already exists")
             self._rules.append(rule)
             self._recalc(rule.protocol)
+        self._fire()
 
     def extend_rules(self, rules: Sequence[AclRule]) -> None:
         """Bulk add: one table recompile per touched protocol instead of
@@ -66,6 +84,7 @@ class SecurityGroup:
             self._rules.extend(rules)
             for proto in {r.protocol for r in rules}:
                 self._recalc(proto)
+        self._fire()
 
     def remove_rule(self, alias: str) -> None:
         with self._lock:
@@ -73,8 +92,10 @@ class SecurityGroup:
                 if r.alias == alias:
                     del self._rules[i]
                     self._recalc(r.protocol)
-                    return
-        raise KeyError(alias)
+                    break
+            else:
+                raise KeyError(alias)
+        self._fire()
 
     def _recalc(self, proto: Proto) -> None:
         sub = [r for r in self._rules if r.protocol == proto]

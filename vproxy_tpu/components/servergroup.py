@@ -301,6 +301,10 @@ class ServerGroup:
         self.servers: list[ServerHandle] = []
         self._checkers: dict[str, _HealthChecker] = {}
         self._listeners: list[Callable[[ServerHandle, bool], None]] = []
+        # bumped on every health edge and membership/weight recalc: a
+        # cheap staleness token for answer caches (dns/server.py) that
+        # must never serve a backend past its DOWN edge
+        self.health_version = 0
         self._lock = threading.Lock()
         self._wrr_seq: list[int] = []
         self._wrr_servers: list[ServerHandle] = []
@@ -395,6 +399,7 @@ class ServerGroup:
 
     def _notify(self, svr: ServerHandle, up: bool) -> None:
         from ..utils import events
+        self.health_version += 1
         events.record("hc_up" if up else "hc_down",
                       f"{self.alias}/{svr.name} {svr.ip}:{svr.port} "
                       + ("UP" if up else "DOWN"),
@@ -489,6 +494,7 @@ class ServerGroup:
     # --------------------------------------------------------- balancing
 
     def _recalc(self) -> None:
+        self.health_version += 1  # membership/weight change
         self._wrr_cache.clear()
 
     @staticmethod

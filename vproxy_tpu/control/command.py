@@ -775,10 +775,19 @@ def _h_switch(app: Application, c: Command):
     if c.action in ("list", "list-detail"):
         if c.action == "list":
             return list(app.switches.keys())
+
+        def fc_str(s) -> str:
+            fc = s.flowcache_info()
+            if fc is None:
+                return "off"
+            state = "on" if fc["active"] else "idle"
+            return (f"{state}(size={fc['size']},used={fc['used']},"
+                    f"gen={fc['gen']},hit-rate={fc['hit_rate']})")
         return [f"{s.alias} -> bind {s.bind_ip}:{s.bind_port} "
                 f"mac-table-timeout {s.mac_table_timeout_ms} "
                 f"arp-table-timeout {s.arp_table_timeout_ms} "
-                f"bare-vxlan-access {s.bare_access.alias}"
+                f"bare-vxlan-access {s.bare_access.alias} "
+                f"flowcache {fc_str(s)}"
                 for s in app.switches.values()]
     if c.action == "update":
         sw = _need(app.switches, c.alias, "switch")

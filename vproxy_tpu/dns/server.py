@@ -19,6 +19,8 @@ Application state.
 """
 from __future__ import annotations
 
+import os
+import time
 from typing import Optional
 
 from ..components.secgroup import SecurityGroup
@@ -57,6 +59,19 @@ class DNSServer:
         self.elg = elg  # attach target for loop-death re-homing
         self.started = False
         self.queries = 0
+        # hot-path answer cache: packed response bytes per (qname,
+        # qtype, rd) for single-question group-backed queries — without
+        # it every repeat query re-walks the group and re-packs records.
+        # Entries pin the tokens they were built under (the rrsets
+        # matcher snapshot = rule generation, the group + its
+        # health_version = backend health edges) and die the instant
+        # either moves; a short TTL bounds how long the DNS-as-LB
+        # rotation is frozen on one backend. VPROXY_TPU_DNS_CACHE_MS=0
+        # disables.
+        self._cache_ms = int(os.environ.get("VPROXY_TPU_DNS_CACHE_MS",
+                                            "1000"))
+        self._ans_cache: dict = {}  # key -> (expires, token, resp bytes)
+        self.cache_hits = 0
 
     # ------------------------------------------------------------ control
 
@@ -154,17 +169,49 @@ class DNSServer:
         resp = P.Packet(id=req.id, is_resp=True, aa=rcode == 0, rd=req.rd,
                         ra=self.recursive is not None, rcode=rcode,
                         questions=list(req.questions), answers=answers)
+        data = resp.encode()
+        ck = getattr(req, "_cache_key", None)
+        if ck is not None and rcode == 0:
+            if len(self._ans_cache) > 4096:
+                self._ans_cache.clear()
+            self._ans_cache[ck] = (
+                time.monotonic() + self._cache_ms / 1000.0,
+                req._cache_token, data)
         if self._fd is not None:
-            vtl.sendto(self._fd, resp.encode(), ip, port)
+            vtl.sendto(self._fd, data, ip, port)
+
+    def _cache_lookup(self, req: P.Packet, q) -> Optional[bytes]:
+        """-> a fresh cached response (id already patched) or None."""
+        key = (q.qname, q.qtype, req.rd)
+        ent = self._ans_cache.get(key)
+        if ent is None:
+            return None
+        expires, (gh, hv, snap), data = ent
+        if (time.monotonic() >= expires
+                or gh.group.health_version != hv
+                or self.rrsets._matcher.snapshot() is not snap):
+            del self._ans_cache[key]
+            return None
+        out = bytearray(data)
+        out[0:2] = req.id.to_bytes(2, "big")
+        return bytes(out)
 
     def _handle(self, req: P.Packet, ip: str, port: int) -> None:
         if not req.questions:
             self._respond(req, ip, port, [], rcode=1)
             return
+        qs = list(req.questions)
+        if len(qs) == 1 and self._cache_ms > 0:
+            hit = self._cache_lookup(req, qs[0])
+            if hit is not None:
+                self.cache_hits += 1
+                if self._fd is not None:
+                    vtl.sendto(self._fd, hit, ip, port)
+                return
         # continuation pipeline over the questions: each rrsets lookup
         # rides the ClassifyService queue (DNSServer.java:136's scan),
         # coalescing with other in-flight queries across datagrams
-        self._handle_q(req, ip, port, list(req.questions), 0, [])
+        self._handle_q(req, ip, port, qs, 0, [])
 
     def _handle_q(self, req: P.Packet, ip: str, port: int, qs: list,
                   i: int, answers: list) -> None:
@@ -205,6 +252,16 @@ class DNSServer:
                         return
                     self._run_recursive(req, ip, port)
                     return
+                # single-question group answer: cacheable — pin the
+                # tokens whose movement must invalidate it. Per-client
+                # picks (source hash, live-connection wlc) must NOT be
+                # cached: one client's backend would serve everyone.
+                # SRV lists all healthy servers, so it is always safe.
+                if len(qs) == 1 and self._cache_ms > 0 and (
+                        q.qtype == P.SRV or gh.group.method == "wrr"):
+                    req._cache_key = (q.qname, q.qtype, req.rd)
+                    req._cache_token = (gh, gh.group.health_version,
+                                        self.rrsets._matcher.snapshot())
                 self._answer_group(q, gh, ip, answers)
                 self._handle_q(req, ip, port, qs, i + 1, answers)
 

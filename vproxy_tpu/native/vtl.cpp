@@ -20,6 +20,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
@@ -1209,5 +1210,483 @@ void vtl_free(void* lp) {
 }
 
 int vtl_errno_eagain() { return EAGAIN; }
+
+// ------------------------------------------------------ switch flow cache
+//
+// The switch's repeat-flow fast lane (the Maglev/Ananta split: a slow
+// "first packet" policy path in Python, a cached-flow path that never
+// leaves C). vtl_switch_poll drains the switch's UDP socket with
+// recvmmsg, probes an open-addressed exact-match flow table keyed on
+// (sender, vni, eth_dst, eth_type, v4 src/dst/proto), and for hits
+// applies the resolved action — raw forward, routed header rewrite
+// (vni + macs + ttl-1 + RFC 1624 incremental checksum), or DROP with a
+// reason — batching forwards into one sendmmsg per egress destination.
+// Misses and non-fast frames are compacted into the caller's buffers
+// exactly like vtl_recvmmsg output, so Python consumes them as a normal
+// burst and (after classifying) installs entries via vtl_flow_install.
+//
+// Correctness is generation-gated: every route/ACL/MAC/ARP/iface
+// mutation bumps the table's generation (vtl_switch_gen_bump, a single
+// atomic — callable from any thread); entries carry the generation they
+// were compiled under and a mismatched probe is a forced miss, so a
+// rule change can never forward through a stale action. Entries also
+// expire after a TTL and evict LRU-ish within the probe window.
+// Table memory is only touched from the owning loop thread (poll +
+// install both run there); only the generation atomic crosses threads.
+
+#pragma pack(push, 1)
+struct FlowKey {          // 26 bytes; must match vtl.py FLOW_REC prefix
+  uint32_t sender_ip;     // host-order u32 of the v4 sender addr
+  uint16_t sender_port;
+  uint8_t vni[3];         // wire vni bytes (pre-override)
+  uint8_t eth_dst[6];
+  uint8_t eth_type[2];
+  uint8_t ip_src[4];      // zeros unless v4/IHL=5 with a sane length
+  uint8_t ip_dst[4];
+  uint8_t proto;
+};
+struct FlowRec {          // install record; must match vtl.py FLOW_REC
+  FlowKey key;
+  uint8_t action;         // FC_ACT_*
+  uint8_t flags;          // bit0 = routed rewrite
+  uint8_t drop_reason;    // index into the shared drop-reason table
+  uint8_t new_vni[3];     // effective/target vni to stamp on egress
+  uint8_t new_dst[6];     // routed rewrite template
+  uint8_t new_src[6];
+  uint32_t out_ip;        // host-order u32 v4 egress addr (FC_ACT_FWD)
+  uint16_t out_port;
+  int32_t tap_fd;         // FC_ACT_TAP egress fd
+};
+#pragma pack(pop)
+static_assert(sizeof(FlowKey) == 26, "FlowKey ABI drifted");
+static_assert(sizeof(FlowRec) == 54, "FlowRec ABI drifted");
+
+#define FC_ACT_EMPTY 0
+#define FC_ACT_FWD 1
+#define FC_ACT_TAP 2
+#define FC_ACT_DROP 3
+#define FC_FLAG_ROUTED 1u
+// drop reasons (shared contract with net/vtl.py FLOW_DROP_REASONS):
+// 0 acl_deny, 1 same_iface, 2 route_miss, 3 unknown_vni,
+// 4 egress_short_write, 5 other
+#define FC_DROP_REASONS 6
+#define FC_R_EGRESS 4
+#define FC_PROBE 8
+
+struct FlowEntry {
+  FlowKey key;
+  uint8_t action, flags, drop_reason;
+  uint8_t new_vni[3], new_dst[6], new_src[6];
+  uint32_t out_ip;
+  uint16_t out_port;
+  int32_t tap_fd;
+  uint64_t gen, expire_us, last_hit_us;
+  // per-entry seqlock: the table is probed by N poller threads
+  // (SO_REUSEPORT multiqueue) while the loop thread installs. Writers
+  // (install only — probes never mutate entries beyond the benign
+  // last_hit_us stat) bump to odd, write, bump to even; readers retry
+  // as a miss on any seq movement. Entries are 1 writer / N readers.
+  uint32_t seq;
+};
+
+struct FlowCache {
+  std::vector<FlowEntry> slots;
+  uint32_t mask = 0;
+  uint64_t ttl_us = 0;
+  std::atomic<uint64_t> gen{0};
+  uint64_t used = 0;
+  // per-table probe outcomes (the globals blend every switch in the
+  // process; list-detail switch wants THIS switch's hit rate)
+  std::atomic<uint64_t> hits{0}, misses{0};
+};
+
+// process-global counters (all switches), pump_counters idiom
+static std::atomic<uint64_t> g_fc_hit(0), g_fc_miss(0), g_fc_evict(0),
+    g_fc_stale(0), g_fc_fwd(0);
+static std::atomic<uint64_t> g_fc_drop[FC_DROP_REASONS];
+
+static uint64_t fc_hash(const FlowKey& k) {
+  const uint8_t* p = (const uint8_t*)&k;
+  uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+  for (size_t i = 0; i < sizeof(FlowKey); ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+void* vtl_flowcache_new(int size, int ttl_ms) {
+  uint32_t cap = 256;
+  while (cap < (uint32_t)size && cap < (1u << 22)) cap <<= 1;
+  FlowCache* fc = new FlowCache();
+  fc->slots.assign(cap, FlowEntry());  // value-init: action == EMPTY
+  fc->mask = cap - 1;
+  fc->ttl_us = (uint64_t)(ttl_ms > 0 ? ttl_ms : 10000) * 1000u;
+  return fc;
+}
+
+void vtl_flowcache_free(void* p) { delete (FlowCache*)p; }
+
+void vtl_switch_gen_bump(void* p) {
+  ((FlowCache*)p)->gen.fetch_add(1, std::memory_order_relaxed);
+}
+
+uint64_t vtl_switch_gen(void* p) {
+  return ((FlowCache*)p)->gen.load(std::memory_order_relaxed);
+}
+
+int vtl_flow_rec_size(void) { return (int)sizeof(FlowRec); }
+
+// out: hit, miss, evict, stale, fwd, drop[FC_DROP_REASONS]; -> count
+int vtl_flowcache_counters(uint64_t* out) {
+  out[0] = g_fc_hit.load(std::memory_order_relaxed);
+  out[1] = g_fc_miss.load(std::memory_order_relaxed);
+  out[2] = g_fc_evict.load(std::memory_order_relaxed);
+  out[3] = g_fc_stale.load(std::memory_order_relaxed);
+  out[4] = g_fc_fwd.load(std::memory_order_relaxed);
+  for (int i = 0; i < FC_DROP_REASONS; ++i)
+    out[5 + i] = g_fc_drop[i].load(std::memory_order_relaxed);
+  return 5 + FC_DROP_REASONS;
+}
+
+// out[0]=capacity, out[1]=used slots, out[2]=generation,
+// out[3]=hits, out[4]=misses (this table only); -> 5
+int vtl_flowcache_stat(void* p, uint64_t* out) {
+  FlowCache* fc = (FlowCache*)p;
+  out[0] = fc->mask + 1;
+  out[1] = fc->used;
+  out[2] = fc->gen.load(std::memory_order_relaxed);
+  out[3] = fc->hits.load(std::memory_order_relaxed);
+  out[4] = fc->misses.load(std::memory_order_relaxed);
+  return 5;
+}
+
+// Install n FlowRecs compiled by the Python fast path, stamped with the
+// generation read BEFORE classification began: if anything mutated
+// since, the whole batch is conservatively skipped (the flows re-miss
+// and recompile against current tables). -> entries installed.
+int vtl_flow_install(void* p, const void* recs, int n, uint64_t gen) {
+  FlowCache* fc = (FlowCache*)p;
+  uint64_t cur = fc->gen.load(std::memory_order_relaxed);
+  if (gen != cur) return 0;
+  uint64_t now = mono_us();
+  const FlowRec* r = (const FlowRec*)recs;
+  int installed = 0;
+  for (int i = 0; i < n; ++i) {
+    const FlowRec& rec = r[i];
+    if (rec.action == FC_ACT_EMPTY) continue;
+    uint64_t h = fc_hash(rec.key);
+    FlowEntry *match = nullptr, *freeslot = nullptr, *lru = nullptr;
+    for (int k = 0; k < FC_PROBE; ++k) {
+      FlowEntry& e = fc->slots[(h + (uint64_t)k) & fc->mask];
+      if (e.action == FC_ACT_EMPTY) {
+        if (!freeslot) freeslot = &e;
+        continue;
+      }
+      if (!memcmp(&e.key, &rec.key, sizeof(FlowKey))) {
+        match = &e;
+        break;
+      }
+      if (e.gen != cur || now >= e.expire_us) {
+        if (!freeslot) freeslot = &e;
+        continue;
+      }
+      if (!lru || e.last_hit_us < lru->last_hit_us) lru = &e;
+    }
+    FlowEntry* dst = match ? match : (freeslot ? freeslot : lru);
+    if (!dst) continue;
+    if (!match && !freeslot)
+      g_fc_evict.fetch_add(1, std::memory_order_relaxed);
+    if (!match && freeslot && freeslot->action == FC_ACT_EMPTY) fc->used++;
+    // seqlock write (install is the only entry mutator, loop thread)
+    uint32_t s = __atomic_load_n(&dst->seq, __ATOMIC_RELAXED);
+    __atomic_store_n(&dst->seq, s + 1, __ATOMIC_RELAXED);
+    __atomic_thread_fence(__ATOMIC_SEQ_CST);
+    dst->key = rec.key;
+    dst->action = rec.action;
+    dst->flags = rec.flags;
+    dst->drop_reason = rec.drop_reason < FC_DROP_REASONS
+                           ? rec.drop_reason : FC_DROP_REASONS - 1;
+    memcpy(dst->new_vni, rec.new_vni, 3);
+    memcpy(dst->new_dst, rec.new_dst, 6);
+    memcpy(dst->new_src, rec.new_src, 6);
+    dst->out_ip = rec.out_ip;
+    dst->out_port = rec.out_port;
+    dst->tap_fd = rec.tap_fd;
+    dst->gen = gen;
+    dst->expire_us = now + fc->ttl_us;
+    dst->last_hit_us = now;
+    __atomic_thread_fence(__ATOMIC_SEQ_CST);
+    __atomic_store_n(&dst->seq, s + 2, __ATOMIC_RELEASE);
+    ++installed;
+  }
+  return installed;
+}
+
+// Probe from any poller thread: copies the matched entry out under its
+// seqlock (any concurrent install movement degrades to a miss). Stale
+// and expired entries are left for the install path to reclaim —
+// readers never mutate table state beyond the last_hit_us stat.
+static bool fc_probe(FlowCache* fc, const FlowKey& key, uint64_t cur,
+                     uint64_t now, FlowEntry* out) {
+  uint64_t h = fc_hash(key);
+  for (int k = 0; k < FC_PROBE; ++k) {
+    FlowEntry& e = fc->slots[(h + (uint64_t)k) & fc->mask];
+    uint32_t s1 = __atomic_load_n(&e.seq, __ATOMIC_ACQUIRE);
+    if (s1 & 1) continue;  // mid-install: miss, reinstall will follow
+    if (e.action == FC_ACT_EMPTY) return false;
+    if (memcmp(&e.key, &key, sizeof(FlowKey))) continue;
+    memcpy(out, &e, sizeof(FlowEntry));
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    if (__atomic_load_n(&e.seq, __ATOMIC_RELAXED) != s1) return false;
+    if (out->gen != cur) {
+      // the generation gate: a mutation since install forces a miss so
+      // the Python policy path re-decides against current tables
+      g_fc_stale.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+    if (now >= out->expire_us) return false;
+    __atomic_store_n(&e.last_hit_us, now, __ATOMIC_RELAXED);
+    return true;
+  }
+  return false;
+}
+
+static bool fc_ip4_csum_ok(const uint8_t* b) {
+  uint32_t s = 0;
+  for (int k = 0; k < 20; k += 2)
+    s += ((uint32_t)b[22 + k] << 8) | b[23 + k];
+  s = (s & 0xFFFF) + (s >> 16);
+  s = (s & 0xFFFF) + (s >> 16);
+  return s == 0xFFFF;
+}
+
+// The native forwarding loop: drain recvmmsg from the switch's UDP
+// socket, forward/drop flow-table hits entirely in C, return misses in
+// vtl_recvmmsg's output format (compacted to the front of the buffers).
+// Returns the miss count; *drained = total datagrams consumed from the
+// socket this call (hits + drops + misses). Loops until the socket is
+// dry, a batch contains misses (those must reach Python in arrival
+// order before we read more), or a 1024-datagram budget (the Python
+// loop keeps calling while progress is made).
+int vtl_switch_poll(void* fcp, int fd, void* buf, int slot, int maxmsgs,
+                    int* lens, char* ips, int ipstride, int* ports,
+                    int* drained) {
+  FlowCache* fc = (FlowCache*)fcp;
+  if (maxmsgs > 512) maxmsgs = 512;
+  static thread_local mmsghdr hdrs[512];
+  static thread_local iovec iovs[512];
+  static thread_local sockaddr_storage addrs[512];
+  static thread_local mmsghdr ehdrs[512];
+  static thread_local iovec eiovs[512];
+  uint64_t now = mono_us();
+  int total = 0;
+  *drained = 0;
+  while (total < 1024) {
+    // re-read per batch: a mutation landing mid-call (iface removal on
+    // another thread) stops being forwarded within one recvmmsg round
+    uint64_t cur = fc->gen.load(std::memory_order_relaxed);
+    for (int i = 0; i < maxmsgs; ++i) {
+      iovs[i].iov_base = (char*)buf + (size_t)i * slot;
+      iovs[i].iov_len = (size_t)slot;
+      memset(&hdrs[i].msg_hdr, 0, sizeof(msghdr));
+      hdrs[i].msg_hdr.msg_iov = &iovs[i];
+      hdrs[i].msg_hdr.msg_iovlen = 1;
+      hdrs[i].msg_hdr.msg_name = &addrs[i];
+      hdrs[i].msg_hdr.msg_namelen = sizeof(sockaddr_storage);
+    }
+    int n = recvmmsg(fd, hdrs, (unsigned)maxmsgs, MSG_DONTWAIT, nullptr);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (total == 0) return -errno;
+      break;
+    }
+    if (n == 0) break;
+    total += n;
+    int miss_idx[512];
+    int nmiss = 0;
+    struct Dest { uint32_t ip; uint16_t port; };
+    Dest dests[64];
+    int ndests = 0;
+    struct Out { uint8_t* p; size_t len; int dest; };
+    Out outs[512];
+    int nouts = 0;
+    for (int i = 0; i < n; ++i) {
+      uint8_t* b = (uint8_t*)buf + (size_t)i * slot;
+      int ln = (int)hdrs[i].msg_len;
+      bool probed = false, consumed = false;
+      // fast-eligible: a bare VXLAN frame (flags byte + reserved zeros,
+      // big enough to carry eth+ipv4) from a v4 sender — everything
+      // else (encrypted user frames, v6 senders, runts) goes to Python
+      if (ln >= 42 && (b[0] & 0x08) && !b[1] && !b[2] &&
+          addrs[i].ss_family == AF_INET) {
+        probed = true;
+        auto* sa = (sockaddr_in*)&addrs[i];
+        FlowKey key;
+        memset(&key, 0, sizeof(key));
+        key.sender_ip = ntohl(sa->sin_addr.s_addr);
+        key.sender_port = ntohs(sa->sin_port);
+        memcpy(key.vni, b + 4, 3);
+        memcpy(key.eth_dst, b + 8, 6);
+        memcpy(key.eth_type, b + 20, 2);
+        int ip_total = 0;
+        if (b[20] == 0x08 && b[21] == 0x00 && b[22] == 0x45) {
+          ip_total = (b[24] << 8) | b[25];
+          if (ip_total >= 20 && ln >= 22 + ip_total) {
+            memcpy(key.ip_src, b + 34, 4);
+            memcpy(key.ip_dst, b + 38, 4);
+            key.proto = b[31];
+          } else {
+            ip_total = 0;  // key stays zero-filled, like the compiler's
+          }
+        }
+        FlowEntry ecopy;
+        FlowEntry* e = fc_probe(fc, key, cur, now, &ecopy) ? &ecopy
+                                                           : nullptr;
+        if (e) {
+          if (e->action == FC_ACT_DROP) {
+            g_fc_drop[e->drop_reason].fetch_add(
+                1, std::memory_order_relaxed);
+            consumed = true;
+          } else if ((e->flags & FC_FLAG_ROUTED) &&
+                     (b[30] <= 1 || !fc_ip4_csum_ok(b))) {
+            // ttl expiry (ICMP time-exceeded) and corrupt headers are
+            // Python's: the object path answers/recomputes for parity
+          } else {
+            int outlen = ln;
+            memcpy(b + 4, e->new_vni, 3);
+            if (e->flags & FC_FLAG_ROUTED) {
+              memcpy(b + 8, e->new_dst, 6);
+              memcpy(b + 14, e->new_src, 6);
+              b[30] -= 1;
+              // RFC 1624 incremental update for the ttl decrement
+              uint32_t c = ((uint32_t)b[32] << 8) | b[33];
+              uint32_t x = (c ^ 0xFFFFu) + 0xFEFFu;
+              x = (x & 0xFFFF) + (x >> 16);
+              x = (x & 0xFFFF) + (x >> 16);
+              c = x ^ 0xFFFFu;
+              b[32] = (uint8_t)(c >> 8);
+              b[33] = (uint8_t)(c & 0xFF);
+              outlen = 22 + ip_total;  // the object path trims trailers
+            }
+            if (e->action == FC_ACT_TAP) {
+              ssize_t w = write(e->tap_fd, b + 8, (size_t)(outlen - 8));
+              if (w < 0)
+                g_fc_drop[FC_R_EGRESS].fetch_add(
+                    1, std::memory_order_relaxed);
+              else
+                g_fc_fwd.fetch_add(1, std::memory_order_relaxed);
+              consumed = true;
+            } else {
+              int d = -1;
+              for (int k = 0; k < ndests; ++k)
+                if (dests[k].ip == e->out_ip &&
+                    dests[k].port == e->out_port) {
+                  d = k;
+                  break;
+                }
+              if (d < 0 && ndests < 64) {
+                dests[ndests].ip = e->out_ip;
+                dests[ndests].port = e->out_port;
+                d = ndests++;
+              }
+              if (d >= 0) {
+                outs[nouts].p = b;
+                outs[nouts].len = (size_t)outlen;
+                outs[nouts].dest = d;
+                ++nouts;
+                consumed = true;
+              }
+              // >64 destinations in one batch: fall through as a miss,
+              // Python's grouped egress handles it (never drop silently)
+            }
+          }
+        }
+      }
+      if (consumed) {
+        g_fc_hit.fetch_add(1, std::memory_order_relaxed);
+        fc->hits.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        if (probed) {
+          g_fc_miss.fetch_add(1, std::memory_order_relaxed);
+          fc->misses.fetch_add(1, std::memory_order_relaxed);
+        }
+        miss_idx[nmiss++] = i;
+      }
+    }
+    // grouped egress: ONE sendmmsg per destination. Must flush before
+    // the next recvmmsg round overwrites the datagram buffers.
+    for (int d = 0; d < ndests; ++d) {
+      sockaddr_in sa;
+      memset(&sa, 0, sizeof(sa));
+      sa.sin_family = AF_INET;
+      sa.sin_addr.s_addr = htonl(dests[d].ip);
+      sa.sin_port = htons(dests[d].port);
+      int m = 0;
+      for (int j = 0; j < nouts; ++j) {
+        if (outs[j].dest != d) continue;
+        eiovs[m].iov_base = outs[j].p;
+        eiovs[m].iov_len = outs[j].len;
+        memset(&ehdrs[m].msg_hdr, 0, sizeof(msghdr));
+        ehdrs[m].msg_hdr.msg_iov = &eiovs[m];
+        ehdrs[m].msg_hdr.msg_iovlen = 1;
+        ehdrs[m].msg_hdr.msg_name = &sa;
+        ehdrs[m].msg_hdr.msg_namelen = sizeof(sa);
+        ++m;
+      }
+      int sent = sendmmsg(fd, ehdrs, (unsigned)m, 0);
+      if (sent < 0) sent = 0;
+      if (sent > 0) g_fc_fwd.fetch_add((uint64_t)sent,
+                                       std::memory_order_relaxed);
+      if (sent < m)  // datagram backpressure: dropped, and counted
+        g_fc_drop[FC_R_EGRESS].fetch_add((uint64_t)(m - sent),
+                                         std::memory_order_relaxed);
+    }
+    if (nmiss) {
+      // compact misses into the caller's vtl_recvmmsg-shaped output;
+      // inet_ntop only runs for misses (hits never pay it)
+      for (int j = 0; j < nmiss; ++j) {
+        int i = miss_idx[j];
+        if (j != i)
+          memmove((char*)buf + (size_t)j * slot,
+                  (char*)buf + (size_t)i * slot, hdrs[i].msg_len);
+        lens[j] = (int)hdrs[i].msg_len;
+        char* ip = ips + (size_t)j * ipstride;
+        ip[0] = 0;
+        ports[j] = 0;
+        if (addrs[i].ss_family == AF_INET) {
+          auto* a = (sockaddr_in*)&addrs[i];
+          inet_ntop(AF_INET, &a->sin_addr, ip, ipstride);
+          ports[j] = ntohs(a->sin_port);
+        } else if (addrs[i].ss_family == AF_INET6) {
+          auto* a = (sockaddr_in6*)&addrs[i];
+          inet_ntop(AF_INET6, &a->sin6_addr, ip, ipstride);
+          ports[j] = ntohs(a->sin6_port);
+        }
+      }
+      *drained = total;
+      return nmiss;
+    }
+    if (n < maxmsgs) break;  // socket likely dry
+  }
+  *drained = total;
+  return 0;
+}
+
+// Block until fd is readable or timeout_ms passes — the poller
+// threads' park (they call vtl_switch_poll on wake). ctypes releases
+// the GIL for the duration, so N pollers wait/forward in parallel.
+// -> 1 readable, 0 timeout, -errno.
+int vtl_wait_readable(int fd, int timeout_ms) {
+  pollfd p;
+  p.fd = fd;
+  p.events = POLLIN;
+  p.revents = 0;
+  int r = poll(&p, 1, timeout_ms);
+  if (r < 0) return errno == EINTR ? 0 : -errno;
+  if (r == 0) return 0;
+  if (p.revents & (POLLERR | POLLNVAL)) return -EBADF;
+  return 1;
+}
 
 }  // extern "C"

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import errno
 import os
+import struct
 import subprocess
 
 _DIR = os.path.join(os.path.dirname(__file__), "..", "native")
@@ -103,6 +104,24 @@ def _load() -> ctypes.CDLL:
     lib.vtl_sendmmsg.argtypes = [c, ctypes.POINTER(ctypes.c_char_p),
                                  ctypes.POINTER(c), c, ctypes.c_char_p,
                                  c, c]
+    try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
+        lib.vtl_flowcache_new.argtypes = [c, c]
+        lib.vtl_flowcache_new.restype = p
+        lib.vtl_flowcache_free.argtypes = [p]
+        lib.vtl_switch_gen_bump.argtypes = [p]
+        lib.vtl_switch_gen.argtypes = [p]
+        lib.vtl_switch_gen.restype = u64
+        lib.vtl_switch_poll.argtypes = [p, c, ctypes.c_void_p, c, c,
+                                        ctypes.POINTER(c), ctypes.c_char_p,
+                                        c, ctypes.POINTER(c),
+                                        ctypes.POINTER(c)]
+        lib.vtl_flow_install.argtypes = [p, ctypes.c_char_p, c, u64]
+        lib.vtl_flowcache_counters.argtypes = [ctypes.POINTER(u64)]
+        lib.vtl_flowcache_stat.argtypes = [p, ctypes.POINTER(u64)]
+        lib.vtl_flow_rec_size.argtypes = []
+        lib.vtl_wait_readable.argtypes = [c, c]
+    except AttributeError:
+        pass
     return lib
 
 
@@ -427,6 +446,125 @@ def recvmmsg(fd: int):
         out.append((ctypes.string_at(base + i * _MMSG_SLOT, lens[i]),
                     ip, ports[i]))
     return out
+
+
+# ------------------------------------------------------ switch flow cache
+#
+# The switch's native fast lane (native/vtl.cpp "switch flow cache"):
+# an in-C exact-match flow table consulted by vtl_switch_poll before any
+# byte reaches Python. The numpy fast path (vswitch/fastpath.py) acts as
+# the flow-entry COMPILER: after classifying a miss burst it installs
+# the resolved actions through flow_install, packed as FLOW_REC records
+# (layout mirrored by the C FlowRec; vtl_flow_rec_size guards ABI
+# drift). Correctness rides the generation gate: every mutation calls
+# switch_gen_bump and a stale-generation probe is a forced miss.
+
+# sender_ip u32, sender_port u16, vni 3s, eth_dst 6s, eth_type 2s,
+# ip_src 4s, ip_dst 4s, proto B | action B, flags B, drop_reason B,
+# new_vni 3s, new_dst 6s, new_src 6s, out_ip u32, out_port u16, tap_fd i
+FLOW_REC = struct.Struct("<IH3s6s2s4s4sBBBB3s6s6sIHi")
+# index contract with the C g_fc_drop table
+FLOW_DROP_REASONS = ("acl_deny", "same_iface", "route_miss",
+                     "unknown_vni", "egress_short_write", "other")
+
+_fc_supported: bool = None  # type: ignore[assignment]
+
+
+def flowcache_supported() -> bool:
+    """Native provider with the flow-cache symbols AND a matching
+    install-record ABI (a stale committed .so fails the size check and
+    the switch silently stays on the Python path)."""
+    global _fc_supported
+    if _fc_supported is None:
+        ok = PROVIDER == "native" and hasattr(LIB, "vtl_flowcache_new")
+        if ok:
+            try:
+                ok = int(LIB.vtl_flow_rec_size()) == FLOW_REC.size
+            except Exception:
+                ok = False
+        _fc_supported = ok
+    return _fc_supported
+
+
+def flowcache_new(size: int, ttl_ms: int) -> int:
+    """-> flow table handle (size rounded up to a power of two)."""
+    return LIB.vtl_flowcache_new(size, ttl_ms)
+
+
+def flowcache_free(handle: int) -> None:
+    if handle:
+        LIB.vtl_flowcache_free(handle)
+
+
+def switch_gen_bump(handle: int) -> None:
+    """One C atomic — safe from any thread, called on every mutation."""
+    LIB.vtl_switch_gen_bump(handle)
+
+
+def switch_gen(handle: int) -> int:
+    return int(LIB.vtl_switch_gen(handle))
+
+
+def flow_install(handle: int, packed: bytes, n: int, gen: int) -> int:
+    """Install n FLOW_REC records stamped with `gen` (read before the
+    classification that compiled them); -> entries installed (0 when a
+    mutation landed in between — conservative skip)."""
+    return LIB.vtl_flow_install(handle, packed, n, gen)
+
+
+def flowcache_counters() -> tuple:
+    """(hit, miss, evict, stale, fwd, drop[6 reasons]) — process-global
+    C atomics; zeros when the provider/.so lacks the cache."""
+    if not flowcache_supported():
+        return (0,) * (5 + len(FLOW_DROP_REASONS))
+    out = (ctypes.c_uint64 * (5 + len(FLOW_DROP_REASONS)))()
+    LIB.vtl_flowcache_counters(out)
+    return tuple(int(x) for x in out)
+
+
+def flowcache_stat(handle: int) -> tuple:
+    """-> (capacity, used_slots, generation, hits, misses) for ONE
+    table (the counters() tallies blend every switch in the process)."""
+    out = (ctypes.c_uint64 * 5)()
+    n = LIB.vtl_flowcache_stat(handle, out)
+    return tuple(int(out[i]) for i in range(n))
+
+
+def wait_readable(fd: int, timeout_ms: int) -> int:
+    """Blocking readable-park for poller threads (GIL released in C):
+    1 readable, 0 timeout; raises on a dead fd."""
+    return check(LIB.vtl_wait_readable(fd, timeout_ms))
+
+
+def switch_poll(handle: int, fd: int):
+    """Run the native forwarding loop over the switch's UDP socket.
+    -> (handled_in_c, misses) where misses is a [(data, ip, port)] burst
+    in recvmmsg's shape and handled_in_c counts datagrams fully consumed
+    in C (forwarded or reason-counted drops)."""
+    global _mmsg_tls
+    if _mmsg_tls is None:
+        import threading
+        _mmsg_tls = threading.local()
+    b = getattr(_mmsg_tls, "bufs", None)
+    if b is None:
+        b = _mmsg_tls.bufs = (
+            ctypes.create_string_buffer(_MMSG_SLOT * _MMSG_MAX),
+            (ctypes.c_int * _MMSG_MAX)(),
+            ctypes.create_string_buffer(64 * _MMSG_MAX),
+            (ctypes.c_int * _MMSG_MAX)())
+    buf, lens, ips, ports = b
+    drained = ctypes.c_int(0)
+    n = LIB.vtl_switch_poll(handle, fd, buf, _MMSG_SLOT, _MMSG_MAX, lens,
+                            ips, 64, ports, ctypes.byref(drained))
+    if n < 0:
+        check(n)
+    base = ctypes.addressof(buf)
+    out = []
+    for i in range(n):
+        ip = ips[64 * i: 64 * (i + 1)].split(b"\0", 1)[0].decode()
+        out.append((ctypes.string_at(base + i * _MMSG_SLOT, lens[i]),
+                    ip, ports[i]))
+    return drained.value - n, out
 
 
 def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:

@@ -25,6 +25,11 @@ operators, via `add fault` / `remove fault` and `GET /faults`) can arm:
     cluster.step.stall       a step dispatch wedges past the barrier
                              deadline, degrading the host to the
                              inline host-index path
+    switch.flowcache.stale   ONE flow-cache generation bump is
+                             suppressed (ctx = switch alias): proves
+                             the generation gate is what prevents the
+                             native flow table forwarding through a
+                             stale action after a rule mutation
 
 Each armed fault carries three independent gates, all optional:
 
@@ -64,6 +69,7 @@ SITES = (
     "cluster.peer.drop",
     "cluster.replicate.torn",
     "cluster.step.stall",
+    "switch.flowcache.stale",
 )
 
 _lock = threading.Lock()

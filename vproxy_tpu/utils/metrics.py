@@ -275,6 +275,24 @@ class GlobalInspection:
                                "tls_handshakes")):
             self.registry.gauge_f(f"vproxy_pump_{k}_total",
                                   lambda i=i: self._pump_counter(i))
+        # switch flow-cache counters (native/vtl.cpp flow table + the
+        # zero-Python forwarding loop): probe outcomes plus native-side
+        # forward/drop totals with drop REASONS preserved — no silent C
+        # drops. Zeros when the provider/.so lacks the cache.
+        for i, k in enumerate(("hit", "miss", "evict", "stale")):
+            self.registry.gauge_f(f"vproxy_switch_flowcache_{k}_total",
+                                  lambda i=i: self._flowcache_counter(i))
+        self.registry.gauge_f("vproxy_switch_native_fwd_total",
+                              lambda: self._flowcache_counter(4))
+        try:  # the reason-index contract lives in net/vtl.py
+            from ..net.vtl import FLOW_DROP_REASONS as _fc_reasons
+        except Exception:  # provider import failure: labels still exist
+            _fc_reasons = ("acl_deny", "same_iface", "route_miss",
+                           "unknown_vni", "egress_short_write", "other")
+        for j, r in enumerate(_fc_reasons):
+            self.registry.gauge_f("vproxy_switch_native_drop_total",
+                                  lambda j=j: self._flowcache_counter(5 + j),
+                                  reason=r)
         # cluster plane (vproxy_tpu/cluster): fleet membership, rule
         # generation convergence, and the step-synchronized dispatch
         # clock — all 0 until a ClusterNode boots
@@ -306,6 +324,11 @@ class GlobalInspection:
     def _pump_counter(i: int) -> float:
         from ..net import vtl
         return float(vtl.pump_counters()[i])
+
+    @staticmethod
+    def _flowcache_counter(i: int) -> float:
+        from ..net import vtl
+        return float(vtl.flowcache_counters()[i])
 
     def _loop_health(self, key: str) -> float:
         with self._lock:

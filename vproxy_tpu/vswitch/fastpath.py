@@ -51,6 +51,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..net import vtl
 from ..rules.ir import Proto
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
@@ -59,6 +60,110 @@ from . import swmetrics
 _log = Logger("swfast")
 
 MIN_BURST = int(os.environ.get("VPROXY_TPU_FASTPATH_MIN", "32"))
+
+# native flow-cache drop reasons (index contract: vtl.FLOW_DROP_REASONS)
+_R_ACL_DENY, _R_SAME_IFACE, _R_ROUTE_MISS, _R_UNKNOWN_VNI = 0, 1, 2, 3
+_ACT_FWD, _ACT_TAP, _ACT_DROP = 1, 2, 3
+_Z4 = b"\x00\x00\x00\x00"
+_Z6 = b"\x00\x00\x00\x00\x00\x00"
+
+
+def _egress_target(iface):
+    """-> (action, out_ip_u32, out_port, tap_fd) when `iface`'s raw
+    egress is expressible as a native flow action: plain UDP to a v4
+    remote (bare / remote-switch links — their raw send is exactly
+    `sendto(switch fd, data, remote)`) or a tap fd write. Anything that
+    transforms frames (encrypting user tunnels, custom test ifaces)
+    returns None and stays on the Python path."""
+    from .iface import BareVXLanIface, RemoteSwitchIface, TapIface
+    if isinstance(iface, TapIface):
+        return _ACT_TAP, 0, 0, iface.fd
+    if isinstance(iface, (BareVXLanIface, RemoteSwitchIface)):
+        ip, port = iface.remote
+        try:
+            b = parse_ip(ip)
+        except (OSError, ValueError):
+            return None
+        if len(b) != 4:
+            return None  # v6 egress: python path
+        return _ACT_FWD, int.from_bytes(b, "big"), int(port), -1
+    return None
+
+
+class _FlowInstaller:
+    """The flow-entry compiler's output stage: per-row verdicts from the
+    numpy fast path packed into native install records, committed in ONE
+    ctypes crossing per burst. Records are stamped with the generation
+    read at construction (before the classification they encode); a
+    mutation landing mid-flush makes the whole batch conservatively
+    stale and the C side skips it — the flows simply re-miss."""
+
+    __slots__ = ("fc", "gen", "burst", "ents", "mat", "lens", "recs")
+
+    def __init__(self, fc, gen, burst, ents, mat, lens):
+        self.fc = fc
+        # the generation read BEFORE any table/ACL classification this
+        # burst (split() reads it ahead of _acl_tables): a mutation
+        # landing anywhere after that read voids the batch in C
+        self.gen = gen
+        self.burst = burst
+        self.ents = ents
+        self.mat = mat
+        self.lens = lens
+        self.recs: list = []
+
+    def _key(self, i, wire_vni, eth_dst):
+        """Key fields exactly as the C loop derives them from the wire
+        bytes (vtl_switch_poll); None when the sender is not v4 (those
+        frames never probe the table)."""
+        e = self.ents[i]
+        if e is None:
+            return None
+        sip = e[5]
+        if sip is None or sip < 0:
+            return None
+        row = self.mat[i]
+        ip_src = ip_dst = _Z4
+        proto = 0
+        if row[20] == 8 and row[21] == 0 and row[22] == 0x45:
+            total = (int(row[24]) << 8) | int(row[25])
+            if total >= 20 and int(self.lens[i]) >= 22 + total:
+                ip_src = row[34:38].tobytes()
+                ip_dst = row[38:42].tobytes()
+                proto = int(row[31])
+        if eth_dst is None:
+            eth_dst = row[8:14].tobytes()
+        return (int(sip), int(self.burst[i][2]),
+                int(wire_vni).to_bytes(3, "big"), eth_dst,
+                row[20:22].tobytes(), ip_src, ip_dst, proto)
+
+    def add_fwd(self, i, wire_vni, out_iface, new_vni, eth_dst=None,
+                new_dst=None, new_src=None, routed=False) -> None:
+        tgt = _egress_target(out_iface)
+        if tgt is None:
+            return
+        k = self._key(i, wire_vni, eth_dst)
+        if k is None:
+            return
+        action, out_ip, out_port, tap_fd = tgt
+        self.recs.append(vtl.FLOW_REC.pack(
+            *k, action, 1 if routed else 0, 0,
+            int(new_vni).to_bytes(3, "big"),
+            new_dst if new_dst is not None else _Z6,
+            new_src if new_src is not None else _Z6,
+            out_ip, out_port, tap_fd))
+
+    def add_drop(self, i, wire_vni, reason) -> None:
+        k = self._key(i, wire_vni, None)
+        if k is None:
+            return
+        self.recs.append(vtl.FLOW_REC.pack(
+            *k, _ACT_DROP, 0, reason, b"\x00\x00\x00", _Z6, _Z6, 0, 0, -1))
+
+    def commit(self) -> None:
+        if self.recs:
+            vtl.flow_install(self.fc, b"".join(self.recs), len(self.recs),
+                             self.gen)
 
 # byte offsets in a vxlan+ether+ipv4 datagram
 _VNI = 4          # 3 bytes
@@ -337,19 +442,26 @@ class SwitchFastPath:
 
     # ------------------------------------------------------------ split
 
-    def split(self, burst: list):
+    def split(self, burst: list, small_ok: bool = False):
         """[(data, ip, port)] -> (leftovers, pending). Leftovers (non-
         bare frames, v6 senders, or everything when the fast path can't
         run) go through the object pipeline first — in arrival order —
         then Switch._input_batch calls flush(pending) to forward the
-        admitted rows. ACL-denied v4-sender rows are consumed here."""
+        admitted rows. ACL-denied v4-sender rows are consumed here.
+        small_ok (native flow-cache miss bursts) waives MIN_BURST: even
+        a lone miss must classify here so its flow entry gets compiled
+        instead of staying per-packet forever."""
         n = len(burst)
-        if n < MIN_BURST:
+        if n < (1 if small_ok else MIN_BURST):
             return burst, None
         from ..utils.mirror import Mirror
         mir = Mirror.get()
         if mir.hot and mir.wants("switch"):
             return burst, None  # taps want the object path
+        # flow-entry stamp: MUST be read before the ACL tables so a rule
+        # swap racing this burst voids every entry it compiles
+        fc = self.sw.flow_handle()
+        gen0 = vtl.switch_gen(fc) if fc is not None else 0
         kind, acl_trie, acl_allow, acl_default = self._acl_tables()
         if kind == "slow":
             return burst, None  # the object path must run the ACL
@@ -393,10 +505,12 @@ class SwitchFastPath:
             ents[i] = e
             src32[i] = e[5]
 
+        denied = None
         if kind == "none":
             if not acl_default:
                 # deny-all with no rules: every bare row is consumed
                 admitted = np.zeros(n, bool)
+                denied = bare
                 swmetrics.drop("acl_deny", int(bare.sum()))
             else:
                 admitted = bare
@@ -415,15 +529,31 @@ class SwitchFastPath:
             # like the slow path's allow_batch filter; unparseable
             # senders go to the slow path whose ACL handles v6 families
             keep = ~bare | (bare & ~src_ok)
-            swmetrics.drop("acl_deny", int((bare & src_ok & ~verdict).sum()))
+            denied = bare & src_ok & ~verdict
+            swmetrics.drop("acl_deny", int(denied.sum()))
+        if denied is not None and denied.any():
+            # compile native DROP entries so the repeat-flow deny cost
+            # is one C probe, not a Python burst — reason-counted in C
+            if fc is not None:
+                inst = _FlowInstaller(fc, gen0, burst, ents, mat, lens)
+                for i in np.nonzero(denied)[0].tolist():
+                    v = (int(mat[i, _VNI]) << 16) | \
+                        (int(mat[i, _VNI + 1]) << 8) | int(mat[i, _VNI + 2])
+                    inst.add_drop(i, v, _R_ACL_DENY)
+                inst.commit()
         leftovers = [burst[i] for i in np.nonzero(keep)[0]]
         if not admitted.any():
             return leftovers, None
-        return leftovers, (burst, mat, lens, admitted, ents)
+        return leftovers, (burst, mat, lens, admitted, ents, gen0)
 
     def flush(self, pending) -> None:
-        burst, mat, lens, admitted, ents = pending
-        self._forward(burst, mat, lens, admitted, ents)
+        burst, mat, lens, admitted, ents, gen0 = pending
+        fc = self.sw.flow_handle()
+        inst = _FlowInstaller(fc, gen0, burst, ents, mat, lens) \
+            if fc is not None else None
+        self._forward(burst, mat, lens, admitted, ents, inst)
+        if inst is not None:
+            inst.commit()
 
     # ------------------------------------------------- forward the admitted
 
@@ -463,7 +593,8 @@ class SwitchFastPath:
                 ents[i][2] = ver
         return row_if, ov
 
-    def _forward(self, burst, mat, lens, admitted, ents) -> None:
+    def _forward(self, burst, mat, lens, admitted, ents,
+                 inst=None) -> None:
         """Forward/drop the admitted rows; admitted-but-ineligible rows
         are re-injected through the object pipeline in one batch at the
         end (their route lookups stay amortized)."""
@@ -498,6 +629,10 @@ class SwitchFastPath:
             net = sw.networks.get(int(vni))
             if net is None:
                 swmetrics.drop("unknown_vni", len(grp))
+                if inst is not None:
+                    for i in grp.tolist():
+                        inst.add_drop(i, int(vni_parsed[i]),
+                                      _R_UNKNOWN_VNI)
                 continue  # consumed: dropped like the slow path
             # learn src macs (multicast srcs are not learned): last
             # occurrence per mac — the per-packet dict writes of the
@@ -521,7 +656,7 @@ class SwitchFastPath:
             owned_macs, owned_ips = self._owned_view(net)
             to_l3 = np.isin(eth_dst64[uni], owned_macs)
             self._l2_forward(net, mat, lens, uni[~to_l3], eth_dst64,
-                             vni_parsed, vni_eff, row_if, slow)
+                             vni_parsed, vni_eff, row_if, slow, inst)
             l3 = uni[to_l3]
             if not len(l3):
                 continue
@@ -611,6 +746,9 @@ class SwitchFastPath:
                 cell = np.zeros(len(l3), np.int64)
             # route miss = consumed drop (slow path drops too)
             swmetrics.drop("route_miss", int((cell == 0).sum()))
+            if inst is not None and (cell == 0).any():
+                for i in l3[cell == 0].tolist():
+                    inst.add_drop(i, int(vni_parsed[i]), _R_ROUTE_MISS)
             hit = l3[cell > 0]
             ridx = cell[cell > 0] - 1
             slow[hit[via[ridx]]] = True  # gateway routes: object path
@@ -618,13 +756,14 @@ class SwitchFastPath:
             hit, ridx = hit[keep], ridx[keep]
             if len(hit):
                 self._deliver_routed(mat, lens, hit, tv[ridx],
-                                     dst32[cell > 0][keep], slow)
+                                     dst32[cell > 0][keep], slow,
+                                     vni_parsed, inst)
         stray = np.nonzero(slow)[0]
         if len(stray):
             self._reinject(burst, stray, vni_eff, row_if)
 
     def _l2_forward(self, net, mat, lens, rows, eth_dst64, vni_parsed,
-                    vni_eff, row_if, slow) -> None:
+                    vni_eff, row_if, slow, inst=None) -> None:
         """Known-unicast L2: forward original bytes (vni patched when
         the ingress iface forces one); mac-miss rows flood via the
         object path."""
@@ -642,6 +781,16 @@ class SwitchFastPath:
         slow[rows[~hitm]] = True  # miss -> flood; no-raw -> object path
         fwd = rows[hitm]
         ifidx = posc[hitm]
+        if inst is not None and len(fwd):
+            # compile L2 entries: forward-to-remote, or a reason-counted
+            # DROP when the egress IS the ingress (hairpin suppression)
+            for j, i in enumerate(fwd.tolist()):
+                out = mifs[int(ifidx[j])]
+                if out is row_if[i]:
+                    inst.add_drop(i, int(vni_parsed[i]), _R_SAME_IFACE)
+                else:
+                    inst.add_fwd(i, int(vni_parsed[i]), out,
+                                 int(vni_eff[i]))
         patch = fwd[vni_eff[fwd] != vni_parsed[fwd]]
         if len(patch):
             mat[patch, _VNI] = (vni_eff[patch] >> 16) & 255
@@ -649,7 +798,8 @@ class SwitchFastPath:
             mat[patch, _VNI + 2] = vni_eff[patch] & 255
         self._egress(mat, fwd, lens[fwd], ifidx, mifs, row_if=row_if)
 
-    def _deliver_routed(self, mat, lens, rows, tvnis, dst32, slow) -> None:
+    def _deliver_routed(self, mat, lens, rows, tvnis, dst32, slow,
+                        vni_parsed=None, inst=None) -> None:
         """Cross-VNI delivery, vectorized: arp + mac resolution via the
         numpy table views, header rewrite in bulk (vni, macs, ttl-1,
         RFC 1624 incremental checksum), egress grouped per iface.
@@ -691,6 +841,15 @@ class SwitchFastPath:
                 continue
             src = target.ips.first_in(target.v4net)
             smac = src[1] if src is not None else b"\x02\x00\x00\x00\x00\x01"
+            if inst is not None:
+                # compile routed entries BEFORE the in-place rewrite:
+                # the key reads the original eth_dst from mat, the
+                # action carries the rewrite template
+                for j, i in enumerate(sub.tolist()):
+                    inst.add_fwd(i, int(vni_parsed[i]),
+                                 mifs[int(mposc[j])], int(tv),
+                                 new_dst=dmac[j].tobytes(), new_src=smac,
+                                 routed=True)
             # bulk header rewrite
             mat[sub, _VNI] = (int(tv) >> 16) & 255
             mat[sub, _VNI + 1] = (int(tv) >> 8) & 255

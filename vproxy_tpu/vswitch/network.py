@@ -33,13 +33,22 @@ class MacTable:
     def __init__(self, timeout_ms: int = MAC_TABLE_TIMEOUT):
         self.timeout_ms = timeout_ms
         self.version = 0
+        # fires on every version bump (mapping change): the owning
+        # switch points this at its flow-cache generation bump so a
+        # topology move can never forward through a stale native entry
+        self.on_change = None
         self._e: dict[bytes, tuple[object, float]] = {}
+
+    def _bump(self) -> None:
+        self.version += 1
+        if self.on_change is not None:
+            self.on_change()
 
     def record(self, mac: bytes, iface) -> None:
         old = self._e.get(mac)
         self._e[mac] = (iface, time.monotonic())
         if old is None or old[0] is not iface:
-            self.version += 1
+            self._bump()
 
     def lookup(self, mac: bytes):
         ent = self._e.get(mac)
@@ -48,7 +57,7 @@ class MacTable:
         iface, ts = ent
         if (time.monotonic() - ts) * 1000 > self.timeout_ms:
             del self._e[mac]
-            self.version += 1
+            self._bump()
             return None
         return iface
 
@@ -56,14 +65,14 @@ class MacTable:
         for mac, (i, _) in list(self._e.items()):
             if i is iface:
                 del self._e[mac]
-                self.version += 1
+                self._bump()
 
     def expire(self) -> None:
         now = time.monotonic()
         for mac, (_, ts) in list(self._e.items()):
             if (now - ts) * 1000 > self.timeout_ms:
                 del self._e[mac]
-                self.version += 1
+                self._bump()
 
     def entries(self) -> list[tuple[str, object]]:
         self.expire()
@@ -77,13 +86,19 @@ class ArpTable:
     def __init__(self, timeout_ms: int = ARP_TABLE_TIMEOUT):
         self.timeout_ms = timeout_ms
         self.version = 0
+        self.on_change = None  # see MacTable.on_change
         self._e: dict[bytes, tuple[bytes, float]] = {}
+
+    def _bump(self) -> None:
+        self.version += 1
+        if self.on_change is not None:
+            self.on_change()
 
     def record(self, ip: bytes, mac: bytes) -> None:
         old = self._e.get(ip)
         self._e[ip] = (mac, time.monotonic())
         if old is None or old[0] != mac:
-            self.version += 1
+            self._bump()
 
     def lookup(self, ip: bytes) -> Optional[bytes]:
         ent = self._e.get(ip)
@@ -92,7 +107,7 @@ class ArpTable:
         mac, ts = ent
         if (time.monotonic() - ts) * 1000 > self.timeout_ms:
             del self._e[ip]
-            self.version += 1
+            self._bump()
             return None
         return mac
 
@@ -101,7 +116,7 @@ class ArpTable:
         for ip, (_, ts) in list(self._e.items()):
             if (now - ts) * 1000 > self.timeout_ms:
                 del self._e[ip]
-                self.version += 1
+                self._bump()
 
     def entries(self) -> list[tuple[str, str]]:
         self.expire()
@@ -116,6 +131,7 @@ class SyntheticIpHolder:
 
     def __init__(self):
         self.version = 0
+        self.on_change = None  # see MacTable.on_change
         self._ips: dict[bytes, bytes] = {}  # ip -> mac
         # first_in runs once per ROUTED PACKET (gateway source pick);
         # memoized per network, invalidated on any mutation. _by_mac is
@@ -133,6 +149,8 @@ class SyntheticIpHolder:
         self._by_mac.setdefault(mac, ip)
         self._first_cache.clear()
         self.version += 1
+        if self.on_change is not None:
+            self.on_change()
 
     def remove(self, ip: bytes) -> None:
         mac = self._ips.pop(ip, None)
@@ -140,6 +158,8 @@ class SyntheticIpHolder:
             self._unindex_mac(ip, mac)
         self._first_cache.clear()
         self.version += 1
+        if self.on_change is not None:
+            self.on_change()
 
     def _unindex_mac(self, ip: bytes, mac: bytes) -> None:
         if self._by_mac.get(mac) == ip:
@@ -193,6 +213,7 @@ class VpcNetwork:
         self.routes = RouteTable()
         self._matcher_v4 = CidrMatcher(backend=matcher_backend)
         self._matcher_v6 = CidrMatcher(backend=matcher_backend)
+        self.on_route_change = None  # see MacTable.on_change
         self.conntrack = None  # installed by the L4 stack
 
     # -------------------------------------------------------------- routes
@@ -208,6 +229,8 @@ class VpcNetwork:
     def _sync_routes(self) -> None:
         self._matcher_v4.set_networks([r.rule for r in self.routes.rules_v4])
         self._matcher_v6.set_networks([r.rule for r in self.routes.rules_v6])
+        if self.on_route_change is not None:
+            self.on_route_change()
 
     def route_lookup(self, ip: bytes) -> Optional[RouteRule]:
         """LPM through the classify engine (insert order = priority,

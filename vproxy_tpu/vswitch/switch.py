@@ -94,6 +94,25 @@ class Switch:
         if _os.environ.get("VPROXY_TPU_SWITCH_FASTPATH", "1") != "0":
             from .fastpath import SwitchFastPath
             self.fastpath = SwitchFastPath(self)
+        # native flow cache (native/vtl.cpp): the in-C exact-match flow
+        # table + forwarding loop. Needs the fast path (it compiles the
+        # entries) and the native provider. VPROXY_TPU_FLOWCACHE=0 forces
+        # the pure Python data plane (A/B + escape hatch).
+        self._fc = None           # C table handle (vtl.flowcache_new)
+        self._fc_active = False   # poll/install gate (bench A/B toggle)
+        self._fc_enabled = (
+            self.fastpath is not None
+            and _os.environ.get("VPROXY_TPU_FLOWCACHE", "1") != "0")
+        # multiqueue ingress: N EXTRA SO_REUSEPORT sockets, each drained
+        # by a plain thread running the C forwarding loop — hits scale
+        # across cores because vtl_switch_poll releases the GIL. Misses
+        # are handed to the owning loop for classification. Per-entry
+        # seqlocks in the C table make concurrent probe-vs-install safe.
+        self._n_pollers = int(_os.environ.get("VPROXY_TPU_SWITCH_POLLERS",
+                                              "0"))
+        self._pollers: list = []
+        self._poller_fds: list[int] = []
+        self._pollers_stop = False
         self._fd: Optional[int] = None
         self._sweeper = None
         self.started = False
@@ -103,14 +122,174 @@ class Switch:
     def start(self) -> None:
         if self.started:
             return
+        self._init_flowcache()
+        self.bare_access.add_listener(self._gen_bump)
         self._bind(self.loop)
         if self.elg is not None:
             self.elg.attach(self)
         self.started = True
 
+    # ------------------------------------------------------- flow cache
+
+    def _init_flowcache(self) -> None:
+        if not self._fc_enabled or self._fc is not None:
+            return
+        if vtl.PROVIDER != "native" or not vtl.flowcache_supported():
+            return
+        import os as _os
+        size = int(_os.environ.get("VPROXY_TPU_FLOWCACHE_SIZE", "65536"))
+        ttl = int(_os.environ.get("VPROXY_TPU_FLOWCACHE_TTL_MS", "10000"))
+        self._fc = vtl.flowcache_new(size, ttl)
+        self._fc_active = True
+
+    def flow_handle(self):
+        """C flow-table handle for the fast path's entry compiler, or
+        None when the native cache is off/inactive."""
+        return self._fc if self._fc_active else None
+
+    def set_flowcache(self, on: bool) -> None:
+        """Hot A/B toggle (bench + operators). Entries survive a
+        disable/enable cycle: mutations keep bumping the generation
+        while inactive, so surviving entries stay correctly gated.
+        Poller threads follow the toggle (their REUSEPORT sockets close
+        on disable so the kernel rehashes all flows to the main sock)."""
+        if on and self._fc is None:
+            self._fc_enabled = True
+            self._init_flowcache()
+        self._fc_active = bool(on) and self._fc is not None
+        if self._fc_active and self.started:
+            self._start_pollers()
+        elif not self._fc_active:
+            self._stop_pollers()
+
+    # ------------------------------------------------ multiqueue pollers
+
+    def _start_pollers(self) -> None:
+        if (self._pollers or self._n_pollers <= 0 or self._fc is None
+                or not self._fc_active or self._fd is None
+                or vtl.PROVIDER != "native"):
+            return
+        import threading
+        self._pollers_stop = False
+        for i in range(self._n_pollers):
+            try:
+                fd = vtl.udp_bind(self.bind_ip, self.bind_port,
+                                  reuseport=True)
+            except OSError:
+                break  # main sock not reuseport-bound: feature inactive
+            vtl.set_rcvbuf(fd, 4 << 20)
+            self._poller_fds.append(fd)
+            th = threading.Thread(target=self._poller_main, args=(fd,),
+                                  name=f"swpoll-{self.alias}-{i}",
+                                  daemon=True)
+            self._pollers.append(th)
+            th.start()
+
+    def _stop_pollers(self) -> None:
+        if not self._pollers:
+            return
+        self._pollers_stop = True
+        ths, self._pollers = self._pollers, []
+        self._poller_fds = []
+        for th in ths:
+            th.join(timeout=2.0)  # wait_readable parks at most 200ms
+
+    @staticmethod
+    def _mirror_blocks() -> bool:
+        """A hot mirror tapping the switch must see EVERY frame: the C
+        lane is bypassed entirely while it is armed (cached hits would
+        be invisible to the tap)."""
+        from ..utils.mirror import Mirror
+        mir = Mirror.get()
+        return mir.hot and mir.wants("switch")
+
+    def _poller_main(self, fd: int) -> None:
+        """One multiqueue lane: park in poll(2), drain through the C
+        forwarding loop, hand misses to the owning event loop. The
+        thread closes its own socket on exit (no cross-thread close/fd
+        reuse race)."""
+        import errno as _errno
+        try:
+            while not self._pollers_stop:
+                try:
+                    if vtl.wait_readable(fd, 200) <= 0:
+                        continue
+                    if self._pollers_stop:
+                        return
+                    fc = self._fc
+                    if fc is None or not self._fc_active:
+                        return
+                    if self._mirror_blocks():
+                        # drain this lane straight to the object path
+                        # so the mirror sees frames the cache would eat
+                        got = vtl.recvmmsg(fd)
+                        if got:
+                            self.loop.run_on_loop(
+                                lambda m=got: self._input_batch(
+                                    m, small_ok=True))
+                        continue
+                    handled, miss = vtl.switch_poll(fc, fd)
+                except OSError as e:
+                    # a dead socket ends the lane (shutdown path); a
+                    # transient error (ENOBUFS under pressure) must NOT
+                    # silently cost 1/N ingress capacity forever
+                    if self._pollers_stop or e.errno == _errno.EBADF:
+                        return
+                    _log.warn(f"switch {self.alias}: poller lane "
+                              f"error (retrying): {e!r}")
+                    time.sleep(0.01)
+                    continue
+                if handled:
+                    swmetrics.rx(handled)
+                if miss:
+                    self.loop.run_on_loop(
+                        lambda m=miss: self._input_batch(m, small_ok=True))
+        finally:
+            vtl.close(fd)
+
+    def _gen_bump(self, *_a) -> None:
+        """Every route/ACL/MAC/ARP/owned-ip/iface mutation lands here:
+        one C atomic bump invalidates every installed flow entry (probe
+        sees a stale generation -> forced miss -> Python re-decides).
+        The switch.flowcache.stale failpoint suppresses one bump to
+        prove the gate is what prevents stale forwarding."""
+        fc = self._fc
+        if fc is None:
+            return
+        from ..utils import failpoint
+        if failpoint.hit("switch.flowcache.stale", self.alias):
+            return
+        vtl.switch_gen_bump(fc)
+
+    def _bump_registry(self) -> None:
+        self._reg_version += 1
+        self._gen_bump()
+
+    def flowcache_info(self) -> Optional[dict]:
+        """`list-detail switch` / tests: THIS switch's table occupancy
+        and probe outcomes (an old .so reporting only 3 stat fields
+        falls back to the process-global tallies)."""
+        if self._fc is None:
+            return None
+        st = vtl.flowcache_stat(self._fc)
+        size, used, gen = st[0], st[1], st[2]
+        if len(st) >= 5:
+            hits, misses = st[3], st[4]
+        else:
+            c = vtl.flowcache_counters()
+            hits, misses = c[0], c[1]
+        return {"active": self._fc_active, "size": size, "used": used,
+                "gen": gen, "hits": hits, "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 4)
+                if hits + misses else 0.0}
+
     def _bind(self, loop) -> None:
         def mk() -> None:
-            self._fd = vtl.udp_bind(self.bind_ip, self.bind_port)
+            # reuseport when multiqueue pollers are configured: their
+            # sockets join this binding and the kernel shards flows
+            self._fd = vtl.udp_bind(
+                self.bind_ip, self.bind_port,
+                reuseport=self._n_pollers > 0 and self._fc is not None)
             # bursty VXLAN ingress: the default ~200KB rcvbuf holds only
             # a few hundred datagrams — absorb whole bursts instead
             vtl.set_rcvbuf(self._fd, 4 << 20)
@@ -123,6 +302,7 @@ class Switch:
             loop.call_sync(mk)
         except OSError as e:
             raise OSError(f"switch {self.alias}: bind failed: {e}") from e
+        self._start_pollers()
 
     def on_loop_death(self, group, lp) -> None:
         """Re-home the switch's VXLAN sock onto a surviving loop when
@@ -144,7 +324,7 @@ class Switch:
         for key, (iface, ts) in list(self.ifaces.items()):
             if isinstance(iface, TapIface):
                 del self.ifaces[key]
-                self._reg_version += 1
+                self._bump_registry()
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -187,8 +367,14 @@ class Switch:
         self.started = False
         if self.elg is not None:
             self.elg.detach(self)
+        self.bare_access.remove_listener(self._gen_bump)
+        self._stop_pollers()
         fd = self._fd
         self._fd = None
+        # detach the handle first (mutation hooks stop bumping), free on
+        # the loop thread where the poll/install paths run
+        fc, self._fc = self._fc, None
+        self._fc_active = False
 
         def rm() -> None:
             if self._sweeper is not None:
@@ -201,6 +387,8 @@ class Switch:
             if fd is not None:
                 self.loop.remove(fd)
                 vtl.close(fd)
+            if fc is not None:
+                vtl.flowcache_free(fc)
         self.loop.run_on_loop(rm)
 
     # ---------------------------------------------------------- resources
@@ -213,13 +401,21 @@ class Switch:
         net = VpcNetwork(vni, v4net, v6net, self.mac_table_timeout_ms,
                          self.arp_table_timeout_ms, self.matcher_backend,
                          annotations=annotations)
+        # every table mutation (mapping changes only, not timestamp
+        # refreshes) invalidates the native flow cache via one atomic
+        net.macs.on_change = self._gen_bump
+        net.arps.on_change = self._gen_bump
+        net.ips.on_change = self._gen_bump
+        net.on_route_change = self._gen_bump
         self.networks[vni] = net
+        self._gen_bump()
         return net
 
     def del_network(self, vni: int) -> None:
         if vni not in self.networks:
             raise KeyError(vni)
         del self.networks[vni]
+        self._gen_bump()
 
     def add_user(self, user: str, password: str, vni: int) -> None:
         """user: 3-8 chars [a-zA-Z0-9], stored '+'-padded to 8 (the wire
@@ -295,12 +491,25 @@ class Switch:
                 out.append(iface)
         return out
 
+    def _close_iface(self, iface: Iface) -> None:
+        """Close AFTER the generation bump — and for tap ifaces (the
+        only kind whose fd lives inside native flow entries) after a
+        grace period longer than any in-flight C poll round, so a
+        racing hit can never write() a recycled descriptor."""
+        if isinstance(iface, TapIface) and self._fc is not None:
+            import threading
+            threading.Timer(0.2, iface.close).start()
+        else:
+            iface.close()
+
     def remove_iface(self, name: str) -> None:
         for key, (iface, _) in list(self.ifaces.items()):
             if iface.name == name:
-                iface.close()
+                # generation bump BEFORE the close: a poller hitting a
+                # native TAP entry must never write a recycled fd
                 del self.ifaces[key]
-                self._reg_version += 1
+                self._bump_registry()
+                self._close_iface(iface)
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -340,7 +549,7 @@ class Switch:
             return 0
 
     def _register(self, key, iface: Iface, permanent: bool = False):
-        self._reg_version += 1
+        self._bump_registry()
         self.ifaces[key] = (iface, float("inf") if permanent else time.monotonic())
         r = getattr(iface, "remote", None)
         if r is not None:
@@ -381,9 +590,9 @@ class Switch:
             if ts == float("inf"):
                 continue
             if (now - ts) * 1000 > IFACE_TIMEOUT_MS:
-                iface.close()
                 del self.ifaces[key]
-                self._reg_version += 1
+                self._bump_registry()  # before close: see remove_iface
+                self._close_iface(iface)
                 self._unindex(key, iface)
                 for net in self.networks.values():
                     net.macs.remove_iface(iface)
@@ -398,7 +607,14 @@ class Switch:
         reference handles one datagram per handler pass
         (Switch.java:629-799); here the burst is the unit so the 5k-rule
         bare ACL and 50k-route LPM cost ONE device dispatch each per
-        burst, not per packet."""
+        burst, not per packet. With the native flow cache active the
+        drain runs INSIDE C (vtl_switch_poll): repeat-flow datagrams are
+        forwarded without ever reaching Python and only misses surface
+        here as a burst."""
+        if self._fc_active and self._fc is not None \
+                and not self._mirror_blocks():
+            self._poll_native(fd)
+            return
         batched = vtl.PROVIDER == "native" and hasattr(vtl, "recvmmsg")
         while self._fd is not None:
             burst = []
@@ -418,6 +634,28 @@ class Switch:
                 return
             self._input_batch(burst)
             if len(burst) < self.RECV_BURST:
+                return
+
+    def _poll_native(self, fd: int) -> None:
+        """The flow-cached drain: C forwards hits, misses accumulate
+        into a Python burst (up to RECV_BURST before classify, so the
+        cold-start all-miss case keeps today's amortization)."""
+        fc = self._fc
+        pending: list = []
+        while self._fd is not None:
+            handled, miss = vtl.switch_poll(fc, fd)
+            if handled:
+                swmetrics.rx(handled)
+            if miss:
+                pending.extend(miss)
+            done = not handled and not miss
+            if pending and (done or len(pending) >= self.RECV_BURST):
+                # small miss bursts still classify+install (small_ok):
+                # a trickle flow must compile its entry, not stay on
+                # the per-packet object path forever
+                self._input_batch(pending, small_ok=True)
+                pending = []
+            if done:
                 return
 
     def _parse_bare(self, data: bytes) -> Optional[Vxlan]:
@@ -458,14 +696,14 @@ class Switch:
             pkt = Vxlan(known.local_side_vni, pkt.ether)
         return pkt, known
 
-    def _input_batch(self, burst) -> None:
+    def _input_batch(self, burst, small_ok: bool = False) -> None:
         swmetrics.rx(len(burst))
         pending = None
         if self.fastpath is not None:
             # leftovers (control frames, non-bare, v6) run through the
             # object pipeline FIRST in arrival order, so their table
             # learns are visible to the vectorized rows flushed after
-            burst, pending = self.fastpath.split(burst)
+            burst, pending = self.fastpath.split(burst, small_ok)
             if not burst:
                 if pending is not None:
                     self.fastpath.flush(pending)
