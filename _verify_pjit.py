@@ -1,0 +1,222 @@
+"""Round-9 verify drive: the pjit-sharded classify engine + stall-free
+double-buffered generation installs, end-to-end through the operator
+surface.
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_pjit.py
+
+Phases:
+  [1] mesh serving by default — VPROXY_TPU_MESH_SERVE=1 on the forced
+      8-device CPU mesh: an upstream built via the COMMAND GRAMMAR
+      lands on backend=jax-sharded without any per-resource knob.
+  [2] real traffic — TcpLB http-splice on loopback, Host-hint routing
+      through the sharded device path (ClassifyService mode=device).
+  [3] generation install mid-traffic with `engine.swap.stall` armed
+      (operator surface: `add fault`): requests keep routing on the OLD
+      generation through the stall, flip atomically after, ZERO failed
+      requests; the upstream generation counter moves.
+  [4] operator read-back — `list-detail upstream` shows backend /
+      generation / table-bytes / checksum; /metrics carries
+      vproxy_engine_{generation,swap_ms,table_bytes}.
+  [5] scale + background install — 100k-rule sharded matcher: sampled
+      parity vs the host index, then a paced standby install while the
+      inline lone-query path stays at microsecond latency.
+"""
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("VPROXY_TPU_MESH_SERVE", "1")
+os.environ.setdefault("VPROXY_TPU_SWAP_STALL_S", "0.8")
+
+from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+import jax  # noqa: E402
+
+assert len(jax.devices()) == 8, jax.devices()
+
+
+def say(msg):
+    print(msg, flush=True)
+
+
+def main():
+    from tests.test_tcplb import IdServer, fast_hc, http_get_id, wait_healthy
+    from vproxy_tpu.control.app import Application
+    from vproxy_tpu.control.command import Command
+    from vproxy_tpu.rules.service import ClassifyService
+    from vproxy_tpu.utils.metrics import GlobalInspection
+
+    svc = ClassifyService.get()
+    svc.mode = "device"  # force the device path end-to-end
+
+    app = Application(workers=2)
+    s_a, s_b = IdServer("A", http=True), IdServer("B", http=True)
+    try:
+        # ---- [1] resources through the command grammar
+        Command.execute(app, "add upstream u0")
+        for alias, srv, host in (("ga", s_a, "a.pjit.example"),
+                                 ("gb", s_b, "b.pjit.example")):
+            Command.execute(
+                app, f"add server-group {alias} timeout 200 period 200 "
+                     f"up 1 down 2")
+            Command.execute(
+                app, f"add server {alias}1 to server-group {alias} "
+                     f"address 127.0.0.1:{srv.port} weight 10")
+            Command.execute(
+                app, f'add server-group {alias} to upstream u0 weight 10 '
+                     f'annotations {{"vproxy/hint-host":"{host}"}}')
+        ups = app.upstreams["u0"]
+        assert ups._matcher.backend == "jax-sharded", ups._matcher.backend
+        say(f"[1] mesh serving by default: upstream u0 matcher backend "
+            f"= {ups._matcher.backend} on {len(jax.devices())} devices")
+        wait_healthy(app.server_groups["ga"], 1)
+        wait_healthy(app.server_groups["gb"], 1)
+        Command.execute(app, "add tcp-lb lb0 address 127.0.0.1:0 "
+                             "upstream u0 protocol http-splice")
+        lb = app.tcp_lbs["lb0"]
+        port = lb.bind_port
+
+        # ---- [2] real traffic through the sharded device path
+        n = 24
+        results = [None] * n
+        ths = []
+
+        def one(i):
+            host = "a.pjit.example" if i % 2 else "b.pjit.example"
+            _, body = http_get_id(port, host)
+            results[i] = (host, body)
+
+        for i in range(n):
+            t = threading.Thread(target=one, args=(i,), daemon=True)
+            t.start()
+            ths.append(t)
+        for t in ths:
+            t.join(20)
+        for i, r in enumerate(results):
+            assert r is not None, f"request {i} hung"
+            host, body = r
+            want = "A" if host.startswith("a.") else "B"
+            assert body == want, (i, host, body)
+        assert svc.stats.device_queries >= 1, "never rode the device path"
+        say(f"[2] {n} http-splice requests Host-routed through the "
+            f"sharded device path (device_queries="
+            f"{svc.stats.device_queries})")
+
+        # ---- [3] stalled generation install mid-traffic
+        gen0 = ups._matcher.generation
+        Command.execute(app, "add fault engine.swap.stall count 1")
+        done = threading.Event()
+        swap_err = []
+
+        def swap():
+            try:
+                # flip gb's hint to c.* — a.* keeps routing throughout
+                Command.execute(
+                    app, 'update server-group gb in upstream u0 '
+                         'annotations {"vproxy/hint-host":"c.pjit.example"}')
+            except Exception as e:  # noqa: BLE001
+                swap_err.append(e)
+            finally:
+                done.set()
+
+        sw = threading.Thread(target=swap, daemon=True)
+        t0 = time.monotonic()
+        sw.start()
+        served = 0
+        old_gen_served = 0
+        while not done.is_set():
+            _, body = http_get_id(port, "a.pjit.example")
+            assert body == "A", body
+            _, body2 = http_get_id(port, "b.pjit.example")
+            assert body2 in ("A", "B"), body2  # old gen: B; new gen: WRR
+            if ups._matcher.generation == gen0:
+                old_gen_served += 1
+            served += 2
+        sw.join(10)
+        stall_s = time.monotonic() - t0
+        assert not swap_err, swap_err
+        assert ups._matcher.generation == gen0 + 1
+        assert old_gen_served >= 1, "no request observed the old gen"
+        # post-swap: c.* now routes to gb's backend
+        _, body = http_get_id(port, "c.pjit.example")
+        assert body == "B", body
+        say(f"[3] stalled install ({stall_s:.2f}s incl. 0.8s failpoint): "
+            f"{served} requests served during it ({old_gen_served} pairs "
+            f"on the old generation), 0 failures; generation "
+            f"{gen0} -> {ups._matcher.generation}; c.pjit.example "
+            f"routes post-swap")
+
+        # ---- [4] operator read-back
+        detail = Command.execute(app, "list-detail upstream")
+        line = detail[0]
+        say(f"[4] list-detail upstream: {line}")
+        assert "backend jax-sharded" in line and "generation" in line
+        assert "table-bytes" in line and "checksum" in line
+        text = GlobalInspection.get().prometheus_string()
+        for fam in ("vproxy_engine_generation",
+                    'vproxy_engine_table_bytes{matcher="hint"}',
+                    "vproxy_engine_swap_ms_count"):
+            assert fam in text, fam
+        hist = GlobalInspection.get().get_histogram(
+            "vproxy_engine_swap_ms", reservoir=512)
+        assert hist.value() >= 1
+        say(f"    /metrics: engine families present, swap_ms count="
+            f"{int(hist.value())}")
+
+        # ---- [5] scale: 100k sharded parity + paced background install
+        from vproxy_tpu.rules.engine import HintMatcher
+        from vproxy_tpu.rules.ir import Hint, HintRule
+        rules = [HintRule(host=f"svc{i}.ns{i % 997}.scale.example")
+                 for i in range(100_000)]
+        t0 = time.time()
+        m = HintMatcher(rules)  # mesh default -> jax-sharded
+        build_s = time.time() - t0
+        assert m.backend == "jax-sharded"
+        got = m.match([Hint.of_host(f"svc{i * 997}.ns{(i * 997) % 997}"
+                                    f".scale.example") for i in range(32)])
+        snap = m.snapshot()
+        for i in range(32):
+            h = Hint.of_host(f"svc{i * 997}.ns{(i * 997) % 997}"
+                             f".scale.example")
+            assert int(got[i]) == m.index_snap(snap, h), i
+        say(f"[5] 100k-rule sharded table built in {build_s:.1f}s, "
+            f"table-bytes {m.published_table_bytes()}, 32/32 sampled "
+            f"parity vs the host index")
+        t_inst = threading.Thread(
+            target=lambda: m.set_rules(list(rules)), daemon=True)
+        t_inst.start()
+        time.sleep(0.2)  # the paced standby compile is running now
+        lats = []
+        while t_inst.is_alive() and len(lats) < 4000:
+            t0 = time.perf_counter()
+            snap = m.snapshot()
+            idx = m.index_snap(snap, Hint.of_host(
+                f"svc{len(lats) % 100_000}.ns{len(lats) % 997}"
+                f".scale.example"))
+            lats.append(time.perf_counter() - t0)
+            assert idx == len(lats) - 1 or idx >= 0
+        t_inst.join(120)
+        assert not t_inst.is_alive(), "install never finished"
+        lats.sort()
+        p99_us = lats[int(len(lats) * 0.99)] * 1e6
+        say(f"[5] lone-query host-index p99 during the paced 100k "
+            f"standby install: {p99_us:.0f}us over {len(lats)} queries")
+        assert p99_us < 5000, p99_us
+
+        say("PJIT VERIFY OK")
+    finally:
+        try:
+            Command.execute(app, "remove fault engine.swap.stall")
+        except Exception:  # noqa: BLE001
+            pass
+        for s in (s_a, s_b):
+            s.close()
+        app.close()
+        ClassifyService.reset()
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

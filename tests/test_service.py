@@ -110,10 +110,10 @@ def test_device_failure_degrades_to_oracle_and_recovers():
     boom = {"on": True}
     real_dispatch = m.dispatch_snap
 
-    def flaky(snap, hints):
+    def flaky(snap, hints, **kw):
         if boom["on"]:
             raise RuntimeError("tunnel dropped")
-        return real_dispatch(snap, hints)
+        return real_dispatch(snap, hints, **kw)
 
     m.dispatch_snap = flaky
     # a batch while the device is broken: served by the oracle, no crash
@@ -312,9 +312,9 @@ def test_latency_budget_reroutes_lone_big_table_queries():
     # make the device path artificially slow (tunnel-like: 50ms)
     real = m.dispatch_snap
 
-    def slow(snap, hints):
+    def slow(snap, hints, **kw):
         time.sleep(0.05)
-        return real(snap, hints)
+        return real(snap, hints, **kw)
 
     m.dispatch_snap = slow
     m.match([Hint.of_host("warm.example.com")] * 16)  # warm jit
@@ -375,10 +375,10 @@ def test_inline_host_path_is_synchronous_and_probes_off_path():
     probe_seen = _t.Event()
     real = m.dispatch_snap
 
-    def slow(snap, hints):
+    def slow(snap, hints, **kw):
         probe_seen.set()          # only the probe thread gets here
         time.sleep(0.02)
-        return real(snap, hints)
+        return real(snap, hints, **kw)
 
     m.dispatch_snap = slow
     caller = _t.get_ident()

@@ -22,9 +22,13 @@ config-as-command-log persistence (control/persist.py):
   (crc32 over the canonical config + every engine table's rule
   checksum — rules/engine.py HintMatcher/CidrMatcher.checksum(), the
   same generation-snapshot the classify dispatch reads). The follower
-  applies the commands OFF-LOOP (this thread, never an event loop),
-  recomputes its own checksum, and only then atomically publishes the
-  new generation. Mismatch => the generation is REJECTED: the follower
+  applies the commands OFF-LOOP (this thread, never an event loop);
+  the engine tables they touch rebuild as STANDBY tables on the
+  engine's background installer (rules/engine.py TableInstaller) and
+  land via atomic pointer swaps, so a fleet-wide rule push never
+  stalls the step loop or an in-flight dispatch. The follower then
+  recomputes its own checksum (after an installer barrier), and only
+  then atomically publishes the new generation. Mismatch => the generation is REJECTED: the follower
   stays at its old generation (vproxy_cluster_generation_lag > 0, a
   `generation_reject` recorder event) and forces a full snapshot on
   the next poll. No two hosts ever REPORT the same generation with
@@ -372,6 +376,21 @@ class Replicator:
                     return False
         finally:
             self._applying = False
+        # the replayed mutations install engine tables through the
+        # background TableInstaller (standby compile + atomic swap —
+        # the serving path never waits on them). Handlers wait for
+        # their own install, but a wait=False mutation path must still
+        # never pair a new generation with an old table checksum:
+        # barrier on the installer before checksumming. A timed-out
+        # barrier is a REJECT with its own reason — comparing against
+        # half-installed tables would masquerade as rule divergence.
+        from ..rules.engine import flush_installs
+        barrier_s = float(os.environ.get(
+            "VPROXY_TPU_INSTALL_BARRIER_S", "300"))
+        if not flush_installs(timeout=barrier_s):
+            self._reject(gen, "engine install barrier timed out "
+                              "(standby table compiles still running)")
+            return False
         got = self.checksum()
         if want is not None and got != want:
             self._reject(gen, f"table checksum mismatch "

@@ -92,12 +92,32 @@ class Upstream:
     # ------------------------------------------------------------- data
 
     def search_for_group(self, hint: Hint) -> Optional[GroupHandle]:
-        idx = self._matcher.match_one(hint)
-        return self.handles[idx] if idx >= 0 else None
+        """Sync hint search against ONE matcher generation: the index
+        is interpreted through the SNAPSHOT's payload (the handle list
+        registered with those rules), never `self.handles` — a standby
+        install publishes seconds after add/remove mutated the live
+        list, and a published-generation index into the mutated list
+        would route wrong (or past the end). Served from the exact
+        O(probes) host index, same winner as the oracle/device."""
+        m = self._matcher
+        snap = m.snapshot()
+        idx = m.index_snap(snap, hint)
+        handles = m.snap_payload(snap)
+        if handles is None:  # pre-first-publish: the live list
+            handles = self.handles
+        return handles[idx] if 0 <= idx < len(handles) else None
 
     def search_batch(self, hints: Sequence[Hint]) -> list[Optional[GroupHandle]]:
-        return [self.handles[i] if i >= 0 else None
-                for i in self._matcher.match(hints)]
+        m = self._matcher
+        snap = m.snapshot()  # one generation for every answer
+        handles = m.snap_payload(snap)
+        if handles is None:
+            handles = self.handles
+        out = []
+        for h in hints:
+            i = m.index_snap(snap, h)
+            out.append(handles[i] if 0 <= i < len(handles) else None)
+        return out
 
     def seek(self, source_ip: bytes, hint: Hint,
              fam: Optional[str] = None,

@@ -325,7 +325,17 @@ def _h_ups(app: Application, c: Command):
         app.upstreams[c.alias] = Upstream(c.alias)
         return "OK"
     if c.action in ("list", "list-detail"):
-        return list(app.upstreams.keys())
+        if c.action == "list":
+            return list(app.upstreams.keys())
+        out = []
+        for u in app.upstreams.values():
+            m = u._matcher
+            out.append(
+                f"{u.alias} -> groups {len(u.handles)} backend {m.backend} "
+                f"rules {m.size()} generation {m.generation} "
+                f"table-bytes {m.published_table_bytes()} "
+                f"checksum {m.checksum():#010x}")
+        return out
     if c.action in ("remove", "force-remove"):
         ups = _need(app.upstreams, c.alias, "upstream")
         if c.action == "remove":

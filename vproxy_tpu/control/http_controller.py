@@ -76,6 +76,15 @@ def _details(app, rtype: str) -> list:
                 "name": h.alias, "weight": h.weight,
                 "annotations": _anno(h.annotations),
             } for h in u.handles],
+            # classify-engine state (docs/perf.md sharded engine):
+            # generation bumps on every atomic standby-table swap
+            "engine": {
+                "backend": u._matcher.backend,
+                "rules": u._matcher.size(),
+                "generation": u._matcher.generation,
+                "tableBytes": u._matcher.published_table_bytes(),
+                "checksum": u._matcher.checksum(),
+            },
         } for a, u in app.upstreams.items()]
     if rtype == "server-group":
         return [{

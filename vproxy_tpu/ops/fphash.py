@@ -86,12 +86,27 @@ def rolling_fnv32(qbytes: np.ndarray, salt: int) -> np.ndarray:
     return _fmix32_np(out)
 
 
+_M32 = 0xFFFFFFFF
+_FNV32_OFFSET_I = int(CK.FNV32_OFFSET)
+_FNV32_PRIME_I = int(CK.FNV32_PRIME)
+
+
+def _fmix32_i(h: int) -> int:
+    """_fmix32_np on a python int — bit-identical mod 2^32."""
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & _M32
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & _M32
+    return h ^ (h >> 16)
+
+
 def fnv32_bytes(key: bytes, salt: int) -> int:
-    h = CK.FNV32_OFFSET ^ np.uint32(salt)
-    with np.errstate(over="ignore"):
-        for by in key:
-            h = np.uint32((h ^ np.uint32(by)) * CK.FNV32_PRIME)
-    return int(_fmix32_np(h))
+    """Python-int FNV-32+fmix (bit-identical to the numpy form, ~10x
+    less GIL hold — this is the standby-install build hot loop)."""
+    h = (_FNV32_OFFSET_I ^ int(salt)) & _M32
+    for by in key:
+        h = ((h ^ by) * _FNV32_PRIME_I) & _M32
+    return _fmix32_i(h)
 
 
 def fnv32_words_np(words: np.ndarray, salt) -> np.ndarray:
@@ -151,7 +166,9 @@ def _place_fp(keys: Sequence[bytes], hasher, cap: int, salt_base: int,
         s_fp2 = (s_slot * 7 + 0xC2B2AE35) & 0x7FFFFFFF
         slots = {}
         ok = True
-        for k in keys:
+        for ki, k in enumerate(keys):
+            if not (ki & 7):
+                CK.coop_yield()  # cooperative: see cuckoo._try_build
             sl = hasher(k, s_slot) & (cap - 1)
             f1, f2 = hasher(k, s_fp1), hasher(k, s_fp2)
             if f1 == 0 and f2 == 0:
@@ -725,8 +742,15 @@ class FpCidrTable:
 
 
 def _fnv32_key16(key: bytes, salt: int) -> int:
-    return int(fnv32_words_np(_pack_words16(
-        np.frombuffer(key, np.uint8)), salt))
+    """fnv32_words_np(_pack_words16(key)) on python ints — bit-identical
+    (LE word packing, 4 FNV rounds, fmix32), ~10x less GIL hold in the
+    cidr fp build loop."""
+    h = (_FNV32_OFFSET_I ^ int(salt)) & _M32
+    for j in range(0, 16, 4):
+        w = (key[j] | (key[j + 1] << 8) | (key[j + 2] << 16)
+             | (key[j + 3] << 24))
+        h = ((h ^ w) * _FNV32_PRIME_I) & _M32
+    return _fmix32_i(h)
 
 
 def _prune_acl_members(items: list, acl) -> list:

@@ -33,6 +33,7 @@ addresses on-device with FNV-32 (u32 wraparound matches numpy).
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -116,6 +117,8 @@ def compile_hint_hash(rules: Sequence[HintRule],
     wu: list[int] = []
     max_hl = max_ul = 0
     for i, r in enumerate(rules):
+        if not (i & 31):
+            CK.coop_yield()
         if r.is_empty():
             continue
         if r.host is not None:
@@ -141,6 +144,8 @@ def compile_hint_hash(rules: Sequence[HintRule],
     r_uri_score = np.zeros(r_cap, np.int32)
 
     for i, r in enumerate(rules):
+        if not (i & 31):
+            CK.coop_yield()
         if r.is_empty():
             continue
         r_active[i] = True
@@ -171,10 +176,14 @@ def compile_hint_hash(rules: Sequence[HintRule],
     # surfaces via the (complete) host bucket with a >= level, so among
     # pure-uri contributions, earliest-per-port dominates. This is what
     # keeps candidate counts O(1) when thousands of rules share one uri.
-    for k in host_buckets:
+    for bi, k in enumerate(host_buckets):
+        if not (bi & 63):
+            CK.coop_yield()
         host_buckets[k] = _prune_list(rules, host_buckets[k],
                                       lambda r: (r.uri, r.port))
-    for k in uri_buckets:
+    for bi, k in enumerate(uri_buckets):
+        if not (bi & 63):
+            CK.coop_yield()
         uri_buckets[k] = _prune_list(rules, uri_buckets[k], lambda r: r.port)
     # wh (host="*") members differ in uri, which the wildcard path must
     # itself score -> dedupe per (uri, port). wu (uri="*") members' host
@@ -232,36 +241,171 @@ def compile_hint_hash(rules: Sequence[HintRule],
               "hb_items": hbc, "ub_items": ubc, "lset": lset_cap})
 
 
-def encode_hint_queries(hints: Sequence, tab: HashHintTable) -> dict:
+def _fill_query_windows(hints: Sequence, hw: int, uw: int, cap: int):
+    """Shared query-byte-window fill for the vectorized encoders:
+    -> (hostb [cap,hw] u8 reversed, hlen, has_host, urib [cap,uw] u8,
+    ulen, has_uri, port). Rows past len(hints) stay zero (pad rows).
+    The small-batch encoder fuses this walk with its per-hint hashing
+    and intentionally does not share it."""
+    q_hostb = np.zeros((cap, hw), np.uint8)
+    q_hlen = np.zeros(cap, np.int32)
+    q_has_host = np.zeros(cap, bool)
+    q_urib = np.zeros((cap, uw), np.uint8)
+    q_ulen = np.zeros(cap, np.int32)
+    q_has_uri = np.zeros(cap, bool)
+    q_port = np.zeros(cap, np.int32)
+    for i, h in enumerate(hints):
+        if h.host is not None:
+            hb = h.host.encode()[::-1]
+            q_hlen[i] = min(len(hb), 1 << 20)
+            q_hostb[i, : min(len(hb), hw)] = np.frombuffer(hb[:hw],
+                                                           np.uint8)
+            q_has_host[i] = True
+        if h.uri is not None:
+            ub = h.uri.encode()
+            q_ulen[i] = min(len(ub), 1 << 20)
+            q_urib[i, : min(len(ub), uw)] = np.frombuffer(ub[:uw],
+                                                          np.uint8)
+            q_has_uri[i] = True
+        q_port[i] = h.port
+    return (q_hostb, q_hlen, q_has_host, q_urib, q_ulen, q_has_uri,
+            q_port)
+
+
+# the python-int FNV form lives in ops/cuckoo (single source for the
+# bit-identity-critical constants); aliased for the hot loop below
+_FNV64_MASK = CK._M64
+_FNV64_PRIME_I = CK._FNV64_PRIME_I
+_FNV64_OFFSET_I = CK._FNV64_OFFSET_I
+# below this batch size the per-hint pure-python encoder wins: the
+# vectorized rolling-FNV pass costs ~W sequential numpy calls whose
+# per-call overhead dwarfs the math on accept-path-sized batches
+# (measured 309us numpy vs ~60us python at b=8, 20k rules)
+SMALL_ENCODE = int(os.environ.get("VPROXY_TPU_SMALL_ENCODE", "32"))
+
+
+def _encode_hint_queries_small(hints: Sequence, tab: HashHintTable,
+                               pad_to: int) -> dict:
+    """Per-hint python encoder, bit-identical outputs to the vectorized
+    path (same probe order: dot suffixes ascending, exact slot last;
+    same shapes: MAXP tier + lset_cap widths), O(bytes) python ints
+    instead of O(W) numpy dispatches."""
+    b = len(hints)
+    cap = max(b, pad_to)
+    W = tab.hw
+    q_hostb = np.zeros((cap, W), np.uint8)
+    q_hlen = np.zeros(cap, np.int32)
+    q_has_host = np.zeros(cap, bool)
+    q_urib = np.zeros((cap, tab.uw), np.uint8)
+    q_ulen = np.zeros(cap, np.int32)
+    q_has_uri = np.zeros(cap, bool)
+    q_port = np.zeros(cap, np.int32)
+
+    s1, s2 = int(tab.host_salts[0]), int(tab.host_salts[1])
+    us1, us2 = int(tab.uri_salts[0]), int(tab.uri_salts[1])
+    hmask = tab.host_cap - 1
+    umask = tab.uri_cap - 1
+    probes: list[list] = []  # per hint: [(plen, slot1, slot2)]
+    uprobes: list[list] = []  # per hint: [(lset_pos, plen, s1, s2)]
+    need = 0
+    for i, h in enumerate(hints):
+        pr: list = []
+        if h.host is not None:
+            hb = h.host.encode()[::-1]
+            hl = min(len(hb), 1 << 20)
+            q_hlen[i] = hl
+            win = hb[:W]
+            q_hostb[i, : len(win)] = np.frombuffer(win, np.uint8)
+            q_has_host[i] = True
+            # one python pass: rolling FNV64 pair + dot probes
+            h1 = _FNV64_OFFSET_I ^ s1
+            h2 = _FNV64_OFFSET_I ^ s2
+            lim = min(len(hb), W - 1)
+            for p in range(lim):
+                by = hb[p]
+                if by == DOT and 1 <= p < hl:
+                    pr.append((p, h1 & hmask, h2 & hmask))
+                h1 = ((h1 ^ by) * _FNV64_PRIME_I) & _FNV64_MASK
+                h2 = ((h2 ^ by) * _FNV64_PRIME_I) & _FNV64_MASK
+            # boundary dot at position lim (a dot can be a probe
+            # position without its byte being hashed into the prefix)
+            if lim < len(hb) and lim < W and hb[lim] == DOT \
+                    and 1 <= lim < hl:
+                pr.append((lim, h1 & hmask, h2 & hmask))
+            if hl <= W - 1:  # exact slot, last (vectorized order)
+                pr.append((hl, h1 & hmask, h2 & hmask))
+        probes.append(pr)
+        need = max(need, len(pr))
+        upr: list = []
+        if h.uri is not None:
+            ub = h.uri.encode()
+            ul = min(len(ub), 1 << 20)
+            q_ulen[i] = ul
+            uwin = ub[: tab.uw]
+            q_urib[i, : len(uwin)] = np.frombuffer(uwin, np.uint8)
+            q_has_uri[i] = True
+            u1 = _FNV64_OFFSET_I ^ us1
+            u2 = _FNV64_OFFSET_I ^ us2
+            pos = 0
+            for li, l in enumerate(tab.lset):
+                if l > ul:
+                    break
+                while pos < l:  # lset ascending: resume the roll
+                    by = uwin[pos] if pos < len(uwin) else 0
+                    u1 = ((u1 ^ by) * _FNV64_PRIME_I) & _FNV64_MASK
+                    u2 = ((u2 ^ by) * _FNV64_PRIME_I) & _FNV64_MASK
+                    pos += 1
+                upr.append((li, l, u1 & umask, u2 & umask))
+        uprobes.append(upr)
+        q_port[i] = h.port
+
+    maxp = next((t for t in MAXP_TIERS if t >= need), MAXP_TIERS[-1])
+    hp_len = np.full((cap, maxp), -1, np.int32)
+    hp_slot1 = np.full((cap, maxp), -1, np.int32)
+    hp_slot2 = np.full((cap, maxp), -1, np.int32)
+    for i, pr in enumerate(probes):
+        for j, (plen, sl1, sl2) in enumerate(pr[:maxp]):
+            hp_len[i, j] = plen
+            hp_slot1[i, j] = sl1
+            hp_slot2[i, j] = sl2
+    lset_cap = tab.caps["lset"]
+    up_len = np.full((cap, lset_cap), -1, np.int32)
+    up_slot1 = np.full((cap, lset_cap), -1, np.int32)
+    up_slot2 = np.full((cap, lset_cap), -1, np.int32)
+    for i, upr in enumerate(uprobes):
+        for (li, l, sl1, sl2) in upr:
+            up_len[i, li] = l
+            up_slot1[i, li] = sl1
+            up_slot2[i, li] = sl2
+
+    return {
+        "hostb": q_hostb, "hlen": q_hlen, "has_host": q_has_host,
+        "urib": q_urib, "ulen": q_ulen, "has_uri": q_has_uri,
+        "port": q_port,
+        "hp_len": hp_len, "hp_slot1": hp_slot1, "hp_slot2": hp_slot2,
+        "up_len": up_len, "up_slot1": up_slot1, "up_slot2": up_slot2,
+    }
+
+
+def encode_hint_queries(hints: Sequence, tab: HashHintTable,
+                        pad_to: int = 0) -> dict:
     """Hints -> device-ready query dict incl. precomputed probe slots.
 
     Host-side work is vectorized numpy: two rolling-FNV passes over the
     reversed host window and the uri window give every suffix/prefix
     hash; probe positions are the dots (host) and the table's rule-uri
-    length set (uri).
+    length set (uri). Batches up to SMALL_ENCODE take the per-hint
+    python path instead (same outputs, ~5x cheaper at accept-path batch
+    sizes). pad_to: emit arrays at this batch bucket, pad rows being
+    invalid probes (never encode padding).
     """
+    if len(hints) <= SMALL_ENCODE:
+        return _encode_hint_queries_small(hints, tab,
+                                          max(pad_to, len(hints)))
     b = len(hints)
     W = tab.hw  # reversed-host compare window (suffix boundary incl.)
-    q_hostb = np.zeros((b, W), np.uint8)
-    q_hlen = np.zeros(b, np.int32)
-    q_has_host = np.zeros(b, bool)
-    q_urib = np.zeros((b, tab.uw), np.uint8)
-    q_ulen = np.zeros(b, np.int32)
-    q_has_uri = np.zeros(b, bool)
-    q_port = np.zeros(b, np.int32)
-    for i, h in enumerate(hints):
-        if h.host is not None:
-            hb = h.host.encode()[::-1]
-            q_hlen[i] = min(len(hb), 1 << 20)
-            q_hostb[i, : min(len(hb), W)] = np.frombuffer(hb[:W], np.uint8)
-            q_has_host[i] = True
-        if h.uri is not None:
-            ub = h.uri.encode()
-            q_ulen[i] = min(len(ub), 1 << 20)
-            q_urib[i, : min(len(ub), tab.uw)] = np.frombuffer(
-                ub[: tab.uw], np.uint8)
-            q_has_uri[i] = True
-        q_port[i] = h.port
+    (q_hostb, q_hlen, q_has_host, q_urib, q_ulen, q_has_uri,
+     q_port) = _fill_query_windows(hints, W, tab.uw, b)
 
     # --- host probes: exact (p = hlen) + every dot position p (suffix).
     # Valid probe lengths p <= hw-1 (no rule host is longer), so the
@@ -461,6 +605,8 @@ def compile_cidr_hash(networks: Sequence, acl: Optional[Sequence[AclRule]] = Non
 
     groups: dict[tuple, dict[bytes, list[int]]] = {}
     for i, net in enumerate(networks):
+        if not (i & 31):
+            CK.coop_yield()
         for key, mask, fam in _expand_patterns(net):
             groups.setdefault((fam, mask), {}).setdefault(key, []).append(i)
 
@@ -617,6 +763,11 @@ class ShardedHashTable:
     shard_size: int  # rules per shard (global idx = shard * size + local)
     n: int
     r_cap: int  # per-shard capacity
+    # hint tables only (compile_hint_hash_sharded): the sorted union of
+    # the shards' rule-uri length sets, precomputed so the single-pass
+    # encoder does no per-dispatch set algebra; None for cidr/foreign
+    # stabs (the encoder falls back to the legacy per-shard path)
+    lset_u: Optional[list] = None
 
 
 def _unify_caps(tabs_caps: list) -> dict:
@@ -639,21 +790,34 @@ def _compile_sharded(items: Sequence, n_shards: int, compile_one,
     tables (ACL windows) stay aligned with the slicing by construction.
     When caps is supplied (the runtime-update fast path), the result
     MUST fit: growth raises CapsExceeded instead of silently changing
-    shapes and retracing the caller's jitted classify."""
+    shapes and retracing the caller's jitted classify.
+
+    Memory-lean: once the per-shard arrays are stacked, the per-shard
+    copies are dropped (the shard objects stay — encoders read their
+    salts/caps/lset, never the arrays). A 1M-rule table would otherwise
+    sit in host RAM twice before it ever reaches the device."""
     reused = dict(caps) if caps else None
     per = max(1, -(-len(items) // n_shards))  # ceil; empty tail shards ok
     slices = [list(items[d * per: (d + 1) * per]) for d in range(n_shards)]
     caps = dict(caps or {})
     for _ in range(6):  # caps only grow; fixed point in a few rounds
-        tabs = [compile_one(s, d * per, caps)
-                for d, s in enumerate(slices)]
+        tabs = []
+        for d, s in enumerate(slices):
+            tabs.append(compile_one(s, d * per, caps))
+            CK.coop_yield()  # standby-compile courtesy: explicit
+            #                  preemption point between shard builds
         merged = _unify_caps([t.caps for t in tabs])
         if all(t.caps == merged for t in tabs):
             if reused is not None and merged != reused:
                 raise CapsExceeded(
                     f"update outgrew reused caps: {reused} -> {merged}")
-            arrays = {k: np.stack([t.arrays[k] for t in tabs])
-                      for k in tabs[0].arrays}
+            arrays = {}
+            for k in tabs[0].arrays:
+                CK.coop_yield()  # stack chunks are multi-MB memcpys:
+                #                  paced per key like the build loops
+                arrays[k] = np.stack([t.arrays[k] for t in tabs])
+            for t in tabs:
+                t.arrays = {}
             return ShardedHashTable(shards=tabs, arrays=arrays,
                                     shard_size=per, n=len(items),
                                     r_cap=tabs[0].r_cap)
@@ -663,9 +827,33 @@ def _compile_sharded(items: Sequence, n_shards: int, compile_one,
 
 def compile_hint_hash_sharded(rules: Sequence[HintRule], n_shards: int,
                               caps: Optional[dict] = None) -> ShardedHashTable:
-    return _compile_sharded(
+    """Per-shard compiles under unified caps, plus the UNION uri-length
+    cap ("lset_u") the single-pass sharded encoder sizes its probe axis
+    by: a caps-stable width, so same-caps rule updates keep one query
+    trace shape (the no-retrace contract) — an update whose uri-length
+    union outgrows it raises CapsExceeded like any other caps growth
+    (the engine transparently rebuilds + retraces once)."""
+    reused_u = (caps or {}).get("lset_u")
+    inner = dict(caps) if caps else None
+    if inner is not None:
+        inner.pop("lset_u", None)  # per-shard compiles don't know it
+    stab = _compile_sharded(
         rules, n_shards,
-        lambda s, off, caps: compile_hint_hash(s, caps=caps), caps)
+        lambda s, off, caps: compile_hint_hash(s, caps=caps), inner)
+    union = set()
+    for t in stab.shards:
+        union.update(t.lset)
+    u_cap = _pow2(max(len(union), 1), 4)
+    if reused_u:
+        if u_cap > reused_u:
+            raise CapsExceeded(
+                f"uri-length union outgrew reused cap: {reused_u} -> "
+                f"{u_cap}")
+        u_cap = reused_u
+    for t in stab.shards:
+        t.caps["lset_u"] = u_cap
+    stab.lset_u = sorted(union)
+    return stab
 
 
 def compile_cidr_hash_sharded(networks: Sequence, n_shards: int,
@@ -680,12 +868,118 @@ def compile_cidr_hash_sharded(networks: Sequence, n_shards: int,
             caps=caps), caps)
 
 
-def encode_hint_queries_sharded(hints: Sequence,
-                                stab: ShardedHashTable) -> dict:
+def encode_hint_queries_sharded(hints: Sequence, stab: ShardedHashTable,
+                                pad_to: Optional[int] = None) -> dict:
     """Per-shard probe encoding stacked on the leading shard axis.
 
     Probe slots/salts are shard-local, so the same hint batch encodes
-    differently per shard; each device receives only its own slice
-    (the stacked dims are sharded (rules, batch) on the mesh)."""
-    per = [encode_hint_queries(hints, t) for t in stab.shards]
-    return {k: np.stack([p[k] for p in per]) for k in per[0]}
+    differently per shard — but only in the HASH VALUES: the unified
+    caps guarantee every shard shares the compare windows and table
+    capacities, and the probe POSITIONS (dots, uri lengths) depend only
+    on query content. So this runs the byte walk and the rolling-FNV
+    pass ONCE for all shards (rolling_fnv64_multi over the salt
+    vector), instead of the S sequential re-encodes the original path
+    paid — measured 8x of the whole dispatch's host cost at S=8.
+
+    uri probes ride the UNION of the shards' rule-uri length sets: a
+    probe at a length some shard lacks byte-verifies off (no key of
+    that length exists there), so correctness is per-shard exact while
+    the probe arrays stay shard-uniform.
+
+    pad_to: encode the real hints only and zero/-1-fill the probe rows
+    up to the batch bucket (a pad row has no probes and can never
+    match). Each device still receives only its own slice (the stacked
+    dims are sharded (rules, batch) on the mesh)."""
+    shards = stab.shards
+    t0 = shards[0]
+    # compile_hint_hash_sharded guarantees unified shard shapes and
+    # precomputes the uri-length union; a foreign-built stab (no
+    # lset_u) pays the uniformity scan once per dispatch or drops to
+    # the legacy per-shard encode
+    if stab.lset_u is None and not all(
+            t.hw == t0.hw and t.uw == t0.uw
+            and t.host_cap == t0.host_cap and t.uri_cap == t0.uri_cap
+            for t in shards):
+        # non-unified shard shapes (foreign-built stab): legacy path
+        if pad_to and pad_to > len(hints):
+            from ..rules.ir import Hint
+            hints = list(hints) + [Hint()] * (pad_to - len(hints))
+        per = [encode_hint_queries(hints, t) for t in shards]
+        return {k: np.stack([p[k] for p in per]) for k in per[0]}
+
+    S = len(shards)
+    b = len(hints)
+    cap = max(b, pad_to or 0)
+    W = t0.hw
+    (q_hostb, q_hlen, q_has_host, q_urib, q_ulen, q_has_uri,
+     q_port) = _fill_query_windows(hints, W, t0.uw, cap)
+
+    def shared(a: np.ndarray) -> np.ndarray:
+        # shard-invariant keys: a zero-stride broadcast view on the
+        # shard axis (device_put materializes each device's slice)
+        return np.broadcast_to(a, (S,) + a.shape)
+
+    # --- host probes (positions shared; slots per shard salt)
+    h1 = CK.rolling_fnv64_multi(
+        q_hostb[:, : W - 1],
+        [t.host_salts[0] for t in shards])  # [S, cap, W]
+    h2 = CK.rolling_fnv64_multi(
+        q_hostb[:, : W - 1], [t.host_salts[1] for t in shards])
+    pos = np.arange(W)[None, :]
+    probe_ok = np.concatenate([
+        (q_hostb == DOT) & (pos < q_hlen[:, None]) & (pos >= 1),
+        (q_has_host & (q_hlen <= W - 1))[:, None],  # exact slot
+    ], axis=1) & q_has_host[:, None]  # [cap, W+1]
+    probe_len = np.concatenate([
+        np.broadcast_to(pos, (cap, W)), q_hlen[:, None],
+    ], axis=1).astype(np.int32)
+    need = int(probe_ok.sum(axis=1).max(initial=0))
+    maxp = next((t for t in MAXP_TIERS if t >= need), MAXP_TIERS[-1])
+    order = np.argsort(~probe_ok, axis=1, kind="stable")[:, :maxp]
+    pv = np.take_along_axis(probe_ok, order, 1)
+    pl = np.where(pv, np.take_along_axis(probe_len, order, 1), 0)
+    hp_len = np.where(pv, pl, -1).astype(np.int32)  # [cap, P] shared
+    mask = np.uint64(t0.host_cap - 1)
+    pl_s = np.broadcast_to(pl, (S,) + pl.shape)
+    hp_slot1 = np.where(pv[None],
+                        (np.take_along_axis(h1, pl_s, 2) & mask)
+                        .astype(np.int32), -1)
+    hp_slot2 = np.where(pv[None],
+                        (np.take_along_axis(h2, pl_s, 2) & mask)
+                        .astype(np.int32), -1)
+
+    # --- uri probes at the UNION of the shards' rule-uri length sets;
+    # width = the caps-stable "lset_u" cap (compile_hint_hash_sharded)
+    # so caps-reusing updates keep ONE query trace shape
+    lset_u = stab.lset_u if stab.lset_u is not None else sorted(
+        set().union(*[set(t.lset) for t in shards]))
+    lw = t0.caps.get("lset_u") or _pow2(max(len(lset_u), 1), 4)
+    lset = np.full(lw, -1, np.int32)
+    lset[: len(lset_u)] = lset_u
+    u1 = CK.rolling_fnv64_multi(q_urib,
+                                [t.uri_salts[0] for t in shards])
+    u2 = CK.rolling_fnv64_multi(q_urib,
+                                [t.uri_salts[1] for t in shards])
+    lv = (lset[None, :] >= 0) & (lset[None, :] <= q_ulen[:, None]) & \
+        q_has_uri[:, None]  # [cap, lw]
+    ll = np.where(lv, np.maximum(lset[None, :], 0), 0)
+    umask = np.uint64(t0.uri_cap - 1)
+    up_len = np.where(lv, ll, -1).astype(np.int32)  # shared
+    ll_s = np.broadcast_to(ll, (S,) + ll.shape)
+    up_slot1 = np.where(lv[None],
+                        (np.take_along_axis(u1, ll_s, 2) & umask)
+                        .astype(np.int32), -1)
+    up_slot2 = np.where(lv[None],
+                        (np.take_along_axis(u2, ll_s, 2) & umask)
+                        .astype(np.int32), -1)
+
+    return {
+        "hostb": shared(q_hostb), "hlen": shared(q_hlen),
+        "has_host": shared(q_has_host),
+        "urib": shared(q_urib), "ulen": shared(q_ulen),
+        "has_uri": shared(q_has_uri), "port": shared(q_port),
+        "hp_len": shared(hp_len), "hp_slot1": hp_slot1,
+        "hp_slot2": hp_slot2,
+        "up_len": shared(up_len), "up_slot1": up_slot1,
+        "up_slot2": up_slot2,
+    }

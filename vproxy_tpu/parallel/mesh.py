@@ -222,11 +222,13 @@ def shard_hint_queries(q: dict, mesh: Mesh) -> dict:
 def shard_addr_queries(addr: np.ndarray, fam: np.ndarray, mesh: Mesh,
                        port: Optional[np.ndarray] = None):
     ba = batch_axes(mesh)
-    a = put(mesh, P(ba, None), addr)
-    f = put(mesh, P(ba), fam)
-    if port is None:
-        return a, f, None
-    return a, f, put(mesh, P(ba), port)
+    arrs = {"a": addr, "f": fam}
+    specs = {"a": P(ba, None), "f": P(ba)}
+    if port is not None:
+        arrs["p"] = port
+        specs["p"] = P(ba)
+    out = put_many(mesh, specs, arrs)
+    return out["a"], out["f"], out.get("p")
 
 
 # ------------------------------------------------- hash-path (production)
@@ -249,16 +251,53 @@ def _leading_rules_spec(arrays: dict) -> dict:
 def shard_hash_table(stab, mesh: Mesh) -> dict:
     """Ship a ShardedHashTable's stacked arrays over the mesh (tables
     replicate across host/batch axes; multi-process hosts each pass the
-    identical full array)."""
+    identical full array). Paced per key (ops.cuckoo.coop_yield): a
+    standby install's upload slices multi-MB arrays per device under
+    the GIL — unpaced, that window alone shows up in serving p99."""
+    from ..ops.cuckoo import coop_yield
     specs = _leading_rules_spec(stab.arrays)
-    return {k: put(mesh, specs[k], v) for k, v in stab.arrays.items()}
+    out = {}
+    for k, v in stab.arrays.items():
+        coop_yield()
+        out[k] = put(mesh, specs[k], v)
+    return out
+
+
+def release_host(stab) -> None:
+    """Drop a ShardedHashTable's stacked HOST arrays after the device
+    upload (the standby-swap memory-lean contract): each array is
+    replaced by a zero-size stub that preserves ndim/dtype, which is
+    all the jitted-fn spec builders ({k: v.ndim}) ever read. A 1M-rule
+    generation would otherwise live in host RAM for as long as the
+    matcher keeps its published snapshot."""
+    stab.arrays = {k: np.empty((0,) * v.ndim, v.dtype)
+                   for k, v in stab.arrays.items()}
+
+
+def put_many(mesh: Mesh, specs: dict, arrs: dict) -> dict:
+    """Batched device_put of a query/table dict: ONE call ships every
+    array (the per-key call paid measurable per-transfer overhead on
+    the dispatch path). Falls back to per-key put on multi-process
+    meshes (make_array_from_process_local_data is per-array) or when
+    the runtime rejects the batched form."""
+    keys = list(arrs)
+    if jax.process_count() > 1:
+        return {k: put(mesh, specs[k], arrs[k]) for k in keys}
+    try:
+        out = jax.device_put(
+            [arrs[k] for k in keys],
+            [NamedSharding(mesh, specs[k]) for k in keys])
+        return dict(zip(keys, out))
+    except (TypeError, ValueError):
+        return {k: put(mesh, specs[k], arrs[k]) for k in keys}
 
 
 def shard_hint_queries_sharded(q: dict, mesh: Mesh) -> dict:
     """Stacked per-shard hint encodings: (rules, batch, ...) sharded."""
     ba = batch_axes(mesh)
-    return {k: put(mesh, P("rules", ba, *([None] * (v.ndim - 2))), v)
-            for k, v in q.items()}
+    specs = {k: P("rules", ba, *([None] * (v.ndim - 2)))
+             for k, v in q.items()}
+    return put_many(mesh, specs, q)
 
 
 def _shard_map(body, mesh, in_specs, out_specs):
@@ -268,6 +307,19 @@ def _shard_map(body, mesh, in_specs, out_specs):
         from jax.experimental.shard_map import shard_map
     return shard_map(body, mesh=mesh, in_specs=in_specs,
                      out_specs=out_specs)
+
+
+def _donate_queries(mesh: Mesh, argnums: tuple) -> dict:
+    """jit kwargs donating the per-dispatch QUERY buffers (tables are
+    reused across dispatches and must never be donated). Donation lets
+    XLA alias the uploaded probe arrays instead of copying them —
+    real-accelerator meshes only: the XLA CPU runtime ignores donation
+    with a per-compile warning, which is noise on the virtual test
+    mesh."""
+    devs = mesh.devices.reshape(-1)
+    if len(devs) and devs[0].platform != "cpu":
+        return {"donate_argnums": argnums}
+    return {}
 
 
 def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
@@ -308,7 +360,8 @@ def make_sharded_hint_fn(mesh: Mesh, table_keys_ndim: dict,
          for k, nd in query_keys_ndim.items()},
         P(),
     )
-    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)))
+    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)),
+                   **_donate_queries(mesh, (1,)))
 
 
 def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
@@ -349,7 +402,9 @@ def make_sharded_cidr_fn(mesh: Mesh, table_keys_ndim: dict,
         {k: P("rules", *([None] * (nd - 1)))  # stacked ndims
          for k, nd in table_keys_ndim.items()},
     ) + q_specs
-    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)))
+    return jax.jit(_shard_map(body, mesh, in_specs, P(ba)),
+                   **_donate_queries(mesh, (1, 2, 3) if with_port
+                                     else (1, 2)))
 
 
 def make_sharded_classify(mesh: Mesh, hint_stab, route_stab, acl_stab,

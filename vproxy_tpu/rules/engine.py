@@ -34,14 +34,27 @@ the reference's provider SPI (-Dvfd, FDProvider.java:12-45) as
                 form of the throughput path.
 
 Rule updates never retrace: tables are fixed-capacity (padded), and an
-update recompiles numpy arrays and re-uploads same-shape buffers (the
-double-buffer swap — README "Modifiable when running"). Capacity (or a
-cuckoo bucket tier) grows when exceeded, which recompiles the jitted
-matcher once for the new shapes.
+update recompiles numpy arrays and re-uploads same-shape buffers.
+Capacity (or a cuckoo bucket tier) grows when exceeded, which
+recompiles the jitted matcher once for the new shapes.
+
+Generation installs are DOUBLE-BUFFERED (the Pope MLSys'23 weight-swap
+idiom applied to rule tables): set_rules()/set_networks() hand the new
+rule list to a process-wide background installer (TableInstaller) that
+compiles and device_puts a STANDBY table while dispatchers keep
+serving the published generation, then publishes by one atomic tuple
+swap. Dispatchers never wait on compilation — a 1M-rule compile, a
+slow device upload, or an armed `engine.swap.stall` failpoint delays
+only the install, never a query. Every publish bumps the matcher's
+`generation`, records `vproxy_engine_swap_ms`, and refreshes the
+`vproxy_engine_table_bytes{matcher}` accounting.
 """
 from __future__ import annotations
 
 import os
+import threading
+import time
+import weakref
 from typing import Optional, Sequence
 
 import numpy as np
@@ -50,26 +63,70 @@ from ..ops import hashmatch as H
 from ..ops import tables as T
 from ..ops.bitmatch import unpack_bits
 from ..ops.matchers import cidr_match_jit, hint_match_jit, table_arrays
+from ..utils.log import Logger
 from . import oracle
 from .ir import AclRule, Hint, HintRule, Proto
 
+_log = Logger("engine")
+
+
+def mesh_serving() -> bool:
+    """True when matchers without an explicit backend should serve SPMD
+    over the device mesh. VPROXY_TPU_MESH_SERVE: "1"/"on" forces it,
+    "0"/"off" disables, "auto" (default) shards whenever the mesh spans
+    more than one REAL accelerator device. Virtual host-platform CPU
+    devices (XLA_FLAGS=--xla_force_host_platform_device_count=N) are
+    opt-in ("1"): they share one socket, so SPMD there buys rule-table
+    capacity per device but ~3x dispatch latency (measured r08) — the
+    right default for tests/bench scale runs, the wrong one for every
+    small-table matcher in the process."""
+    mode = os.environ.get("VPROXY_TPU_MESH_SERVE", "auto")
+    if mode in ("0", "off", "no"):
+        return False
+    import jax
+    try:
+        devs = jax.devices()
+    except Exception:
+        return False
+    if len(devs) <= 1:
+        return False
+    if mode in ("1", "on", "yes"):
+        return True
+    return devs[0].platform != "cpu"
+
 
 def default_backend() -> str:
-    return os.environ.get("VPROXY_TPU_MATCHER", "jax")
+    """VPROXY_TPU_MATCHER when set; otherwise the mesh-sharded backend
+    (VPROXY_TPU_MESH_BACKEND, default the byte-verified "jax-sharded")
+    when mesh_serving() says the device mesh should carry the tables,
+    else the single-device "jax" path."""
+    env = os.environ.get("VPROXY_TPU_MATCHER")
+    if env:
+        return env
+    if mesh_serving():
+        return os.environ.get("VPROXY_TPU_MESH_BACKEND", "jax-sharded")
+    return "jax"
 
 
-_MESH = None
+_MESH: Optional[tuple] = None  # ((devices...), batch) -> Mesh
 
 
 def default_mesh():
     """Process-wide (batch, rules) mesh for jax-sharded matchers; batch
-    axis size from VPROXY_TPU_MESH_BATCH (default 1 = rules-only)."""
+    axis size from VPROXY_TPU_MESH_BATCH (default 1 = rules-only).
+
+    Keyed on the CURRENT device set + batch knob, not cached forever: a
+    device-count change after first use (a test-forced mesh, a late
+    jax.distributed bring-up) must produce a fresh mesh, not silently
+    serve the stale one."""
     global _MESH
-    if _MESH is None:
-        from ..parallel import mesh as M
-        _MESH = M.make_mesh(
-            batch=int(os.environ.get("VPROXY_TPU_MESH_BATCH", "1")))
-    return _MESH
+    import jax
+    from ..parallel import mesh as M
+    batch = int(os.environ.get("VPROXY_TPU_MESH_BATCH", "1"))
+    key = (tuple(jax.devices()), batch)
+    if _MESH is None or _MESH[0] != key:
+        _MESH = (key, M.make_mesh(batch=batch))
+    return _MESH[1]
 
 
 def pad_batch(n: int, mult: int = 1, lo: int = 16) -> int:
@@ -102,8 +159,260 @@ def _to_device(arrs: dict) -> dict:
     return out
 
 
+def _sync_standby(dev) -> None:
+    """Materialize a standby table's device buffers BEFORE the publish
+    swap: device_put is async, and an unsynced publish makes the first
+    post-swap dispatch eat the whole table transfer (measured ~30ms
+    spikes at 20k rules — the install thread must pay that wait, never
+    a serving thread). Best-effort: a backend whose block_until_ready
+    lies (axon tunnel) just keeps the old behavior."""
+    if not dev:
+        return
+    import jax
+    try:
+        jax.block_until_ready(list(dev.values()))
+    except Exception:
+        pass
+
+
+# batch padding at the ARRAY level: a pad row must read as "no probes,
+# no match" to the kernel. The cuckoo query arrays mark invalid probes
+# with -1 (slot/len); everything else (fp fingerprints, byte windows,
+# flags) zero-fills — exactly what encoding an empty Hint() produces,
+# without paying the encode for it.
+_PAD_CUCKOO = {"hp_len": -1, "hp_slot1": -1, "hp_slot2": -1,
+               "up_len": -1, "up_slot1": -1, "up_slot2": -1}
+
+
+def _pad_hint_q(q: dict, cap: int, fills: dict) -> dict:
+    out = {}
+    for k, v in q.items():
+        n = v.shape[0]
+        if n >= cap:
+            out[k] = v
+            continue
+        pad = np.full((cap - n,) + v.shape[1:], fills.get(k, 0), v.dtype)
+        out[k] = np.concatenate([v, pad])
+    return out
+
+
+# --------------------------------------------- generation-install plumbing
+#
+# Process-wide accounting of published table generations, surfaced on
+# /metrics (utils/metrics) and in `list-detail upstream`:
+#   vproxy_engine_generation      — total generation publishes
+#   vproxy_engine_swap_ms         — install latency histogram (compile +
+#                                   upload + publish, background thread)
+#   vproxy_engine_table_bytes{matcher="hint"|"cidr"} — device bytes of
+#                                   every live matcher's published table
+
+_gen_lock = threading.Lock()
+_GENERATION = [0]
+_MATCHERS: "weakref.WeakSet" = weakref.WeakSet()
+_LAST_SERVE = [0.0]  # monotonic ts of the last serving-path read
+
+
+def note_serving() -> None:
+    """Serving-path breadcrumb (one float store): dispatch_snap /
+    index_snap and the classify submit path mark activity so the
+    installer only PACES standby compiles when there is serving
+    latency to protect — a batch config apply on an idle process
+    builds at full speed."""
+    _LAST_SERVE[0] = time.monotonic()
+
+
+def serving_recent(window_s: float = 5.0) -> bool:
+    return time.monotonic() - _LAST_SERVE[0] < window_s
+
+
+def generation_total() -> int:
+    return _GENERATION[0]
+
+
+def table_bytes_total(kind: str) -> int:
+    """Sum of published device-table bytes across live matchers of one
+    kind ("hint" | "cidr"). The WeakSet snapshot rides _gen_lock —
+    matcher constructors add concurrently, and CPython raises on a set
+    mutated mid-iteration (a scrape must never lose to a config
+    apply)."""
+    with _gen_lock:
+        matchers = list(_MATCHERS)
+    total = 0
+    for m in matchers:
+        if m._kind == kind:
+            total += m.published_table_bytes()
+    return total
+
+
+def _swap_hist():
+    from ..utils.metrics import GlobalInspection
+    return GlobalInspection.get().get_histogram("vproxy_engine_swap_ms",
+                                                reservoir=512)
+
+
+class _InstallTicket:
+    """One caller's claim on a pending install; `exc` carries the
+    compile failure back to a waiting set_rules()."""
+
+    __slots__ = ("ev", "exc")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.exc: Optional[BaseException] = None
+
+
+class TableInstaller:
+    """The double-buffer worker: compiles + uploads STANDBY tables off
+    the mutation path, one install at a time, then lets the matcher
+    publish with an atomic tuple swap.
+
+    * set_rules()/set_networks() enqueue (args, payload) and by default
+      WAIT for the publish (read-your-writes for config handlers and
+      the cluster replication checksum gate); wait=False callers get a
+      ticket they can ignore.
+    * dispatchers never wait: they read the published snapshot, which
+      only ever changes by one atomic assignment AFTER the standby
+      table is fully built and uploaded.
+    * back-to-back installs for one matcher COALESCE: only the newest
+      pending rule list compiles; earlier waiters are released by the
+      newer publish (their write was superseded — same last-writer-wins
+      outcome as racing synchronous compiles, at one compile's cost).
+    * the compile yields the GIL between phases (sleep(0)) so a
+      million-rule build starves inline accept-path answers by at most
+      one interpreter slice, not whole seconds.
+    * failpoint `engine.swap.stall` sleeps VPROXY_TPU_SWAP_STALL_S
+      inside the worker — the provable "slow install stalls nothing"
+      edge.
+    """
+
+    _instance: Optional["TableInstaller"] = None
+    _ilock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "TableInstaller":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = TableInstaller()
+            return cls._instance
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        # id(matcher) -> (matcher, args, [tickets]); order preserved
+        self._jobs: dict[int, tuple] = {}
+        self._order: list[int] = []
+        self._inflight = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, matcher, args: tuple) -> _InstallTicket:
+        t = _InstallTicket()
+        with self._cv:
+            key = id(matcher)
+            job = self._jobs.get(key)
+            if job is None:
+                self._jobs[key] = (matcher, args, [t])
+                self._order.append(key)
+            else:  # coalesce: newest rules win, all waiters ride along
+                self._jobs[key] = (matcher, args, job[2] + [t])
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="engine-install", daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        return t
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every pending install published (True) or the
+        timeout passed (False). The cluster replication gate calls this
+        before checksumming so a wait=False mutation can never pair an
+        old table checksum with a new generation."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._jobs or self._inflight:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(0.05 if left is None else min(left, 0.05))
+        return True
+
+    def _run(self) -> None:
+        from ..ops.cuckoo import set_build_pacing
+        from ..utils import failpoint
+        try:
+            # background-priority: the standby compile must lose every
+            # scheduling fight with a serving thread. GIL handoff is
+            # interval-driven either way (service shrinks it to ~1ms),
+            # but the compile's GIL-released phases (numpy, XLA
+            # compile, device transfers) otherwise steal the serving
+            # path's cores — measured 5x p99 inflation on a shared
+            # socket without this.
+            os.setpriority(os.PRIO_PROCESS, threading.get_native_id(), 15)
+        except (AttributeError, OSError, PermissionError):
+            pass  # non-linux / restricted: yields below still apply
+        while True:
+            with self._cv:
+                while not self._order:
+                    self._cv.wait(1.0)
+                key = self._order.pop(0)
+                matcher, args, tickets = self._jobs.pop(key)
+                self._inflight += 1
+            exc: Optional[BaseException] = None
+            try:
+                if failpoint.hit("engine.swap.stall"):
+                    # a deliberately slow compile: dispatch must keep
+                    # answering the old generation for this whole sleep
+                    time.sleep(float(os.environ.get(
+                        "VPROXY_TPU_SWAP_STALL_S", "0.5")))
+                # standby-compile pacing: each cooperative yield in the
+                # build hot loops sleeps ~r x the work since the last
+                # one, capping this thread's CPU/GIL duty at 1/(1+r). A
+                # full-speed compile costs serving threads ~half the
+                # GIL (measured ~2.5x dispatch p99); pacing trades
+                # install latency (background, invisible by design)
+                # for flat serving latency. Re-read per job:
+                # VPROXY_TPU_INSTALL_PACE=0 disables (tests, batch
+                # loads with no concurrent serving). Applied ONLY
+                # when the serving path was active in the last few
+                # seconds (note_serving) — an idle batch apply
+                # builds at full speed.
+                set_build_pacing(float(os.environ.get(
+                    "VPROXY_TPU_INSTALL_PACE", "6"))
+                    if serving_recent() else 0.0)
+                t0 = time.monotonic()
+                time.sleep(0)  # explicit preemption point pre-compile
+                matcher._install(args)
+                _swap_hist().observe((time.monotonic() - t0) * 1e3)
+            except MemoryError as e:
+                # OOM keeps the log-then-die contract (utils/oom), but
+                # the waiters must still see a FAILED install — a
+                # survivor embedding without the oom handler would
+                # otherwise ack a mutation that never landed
+                exc = e
+                raise
+            except BaseException as e:  # noqa: BLE001 — ticketed
+                exc = e
+                _log.error("standby table install failed; serving "
+                           "generation unchanged", exc=True)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+                for t in tickets:
+                    t.exc = exc
+                    t.ev.set()
+
+
+def flush_installs(timeout: Optional[float] = None) -> bool:
+    """Convenience: wait for all pending generation installs (no-op
+    when the installer never started)."""
+    inst = TableInstaller._instance
+    return True if inst is None else inst.flush(timeout)
+
+
 class HintMatcher:
     """Device-backed (or host-fallback) Upstream/DNS hint matcher."""
+
+    _kind = "hint"
 
     def __init__(self, rules: Sequence[HintRule] = (), backend: Optional[str] = None,
                  payload=None, mesh=None):
@@ -114,6 +423,7 @@ class HintMatcher:
         self._caps: Optional[dict] = None
         self._mesh = mesh  # jax-sharded only (lazily defaulted)
         self._fn = None    # jax-sharded jitted matcher (shape-agnostic)
+        self.generation = 0  # bumps on every publish (atomic swap)
         # (tab, dev, rules, payload, index) published as ONE tuple so
         # concurrent readers (the ClassifyService dispatcher) never see a
         # torn table/rule/payload version across a set_rules() swap;
@@ -126,15 +436,52 @@ class HintMatcher:
         self._payload = payload
         self._cksum = None  # (pub-tuple, crc32) cache — see checksum()
         self._recompile()
+        with _gen_lock:
+            _MATCHERS.add(self)
 
     @property
     def rules(self) -> list[HintRule]:
-        return list(self._rules)
+        return list(self._pub[2])  # the PUBLISHED generation
 
-    def set_rules(self, rules: Sequence[HintRule], payload=None) -> None:
+    def set_rules(self, rules: Sequence[HintRule], payload=None,
+                  wait: bool = True) -> None:
+        """Install a new rule generation via the background
+        TableInstaller (standby compile + atomic publish). wait=True
+        (default) blocks THIS caller until the publish — dispatchers
+        never block either way; wait=False returns immediately (the
+        caller reads the old generation until the swap lands)."""
+        t = TableInstaller.get().submit(self, (list(rules), payload))
+        if wait:
+            t.ev.wait()
+            if t.exc is not None:
+                raise t.exc
+
+    def _install(self, args: tuple) -> None:
+        """TableInstaller worker entry: compile + publish one standby
+        generation (never called concurrently — one installer thread).
+        Transactional: a failed compile restores the serving rule list
+        so every read surface still describes the published table."""
+        rules, payload = args
+        old = (self._rules, self._payload, self._tab, self._dev,
+               self._caps)
         self._rules = list(rules)
         self._payload = payload
-        self._recompile()
+        try:
+            self._recompile()
+        except BaseException:
+            # restore EVERYTHING a reader or the next recompile touches
+            # — a half-updated (_tab, _dev) pair would hash queries
+            # with one generation's salts against the other's table
+            (self._rules, self._payload, self._tab, self._dev,
+             self._caps) = old
+            raise
+
+    def published_table_bytes(self) -> int:
+        """Device bytes of the published generation's table arrays."""
+        dev = self._pub[1]
+        if not dev:
+            return 0
+        return int(sum(getattr(v, "nbytes", 0) for v in dev.values()))
 
     def _recompile(self) -> None:
         if self.backend == "jax":
@@ -170,6 +517,11 @@ class HintMatcher:
                 self._tab = compile_sharded(self._rules, shards)
             self._caps = self._tab.shards[0].caps
             self._dev = M.shard_hash_table(self._tab, self._mesh)
+            # memory-lean: the stacked host copy is dead weight once the
+            # device holds the shards (a 1M-rule standby would otherwise
+            # hold table bytes THREE times mid-install); ndims survive
+            # for the jitted-fn spec build
+            M.release_host(self._tab)
             # _fn is NOT reset: it closes over key ndims + kernel only,
             # and jit re-specializes on shape changes by itself — the
             # caps-reuse no-retrace contract depends on keeping it
@@ -189,8 +541,13 @@ class HintMatcher:
         if len(self._rules) > SMALL_TABLE:
             from .index import HintIndex
             idx = HintIndex(self._rules)
+        _sync_standby(self._dev)
+        time.sleep(0)  # preemption point between compile and publish
         self._pub = (self._tab, self._dev, list(self._rules), self._payload,
                      idx)
+        self.generation += 1
+        with _gen_lock:
+            _GENERATION[0] += 1
 
     def encode(self, hints: Sequence[Hint]) -> dict:
         """Pre-encode a query batch for submit() (hash backend only).
@@ -212,8 +569,12 @@ class HintMatcher:
         return np.asarray(self.dispatch_snap(snap, hints))
 
     def match_one(self, hint: Hint) -> int:
-        if self.backend != "host" and len(self._rules) <= SMALL_TABLE:
-            return oracle.search(self._rules, hint)
+        # PUBLISHED rules, never self._rules: a standby install mutates
+        # the latter seconds before the atomic publish, and a serving
+        # read must not route by a generation no surface reports yet
+        pub = self._pub
+        if self.backend != "host" and len(pub[2]) <= SMALL_TABLE:
+            return oracle.search(pub[2], hint)
         return int(self.match([hint])[0])
 
     # ---- ClassifyService API (rules/service.py) ----
@@ -254,6 +615,7 @@ class HintMatcher:
         """O(probes) host lookup against the snapshot's HintIndex (same
         winner as oracle_snap); falls back to the linear oracle when the
         snapshot has no index (host backend)."""
+        note_serving()
         idx = snap[4] if len(snap) > 4 else None
         if idx is None:
             return oracle.search(snap[2], hint)
@@ -262,19 +624,42 @@ class HintMatcher:
     def oracle_one(self, hint: Hint) -> int:
         return self.oracle_snap(self._pub, hint)
 
-    def dispatch_snap(self, snap: tuple, hints: Sequence[Hint]):
+    def dispatch_snap(self, snap: tuple, hints: Sequence[Hint],
+                      pad_to: Optional[int] = None, sync: bool = True):
         """Encode + submit one batch against the snapshotted table
-        generation (async device result; np.asarray() it to block)."""
+        generation (async device result; np.asarray() it to block).
+
+        pad_to: target batch shape (a pad_batch bucket). The hash
+        backends encode ONLY the real hints and zero/invalid-fill the
+        probe arrays to the bucket — the dispatch path never pays the
+        rolling-hash passes for padding rows (they cost the same numpy
+        work as real queries).
+
+        sync=False (the service's double-buffered dispatcher): the
+        sharded backends return the RAW padded device output instead of
+        to_local()[:n] — to_local materializes (np.asarray) on a
+        single process, which would silently turn the "async" submit
+        into a full round-trip wait. The caller np.asarray()s and
+        slices at finish time. Multi-process meshes still to_local here
+        (shard dedup needs it)."""
+        note_serving()
         tab, dev, rules = snap[0], snap[1], snap[2]
         if not rules or not hints:
             return np.full(len(hints), -1, np.int32)
         if self.backend == "jax":
-            q = H.encode_hint_queries(hints, tab)
+            # small batches encode straight into the padded bucket (the
+            # per-hint python path); big ones encode the real rows then
+            # array-pad with invalid probes
+            q = H.encode_hint_queries(hints, tab, pad_to=pad_to or 0)
+            if pad_to and q["hostb"].shape[0] < pad_to:
+                q = _pad_hint_q(q, pad_to, _PAD_CUCKOO)
             idx, _ = H.hint_hash_jit(dev, q)
             return idx
         if self.backend == "jax-fp":
             from ..ops import fphash as F
             q = F.encode_hint_queries_fp(hints, tab)
+            if pad_to and pad_to > len(hints):
+                q = _pad_hint_q(q, pad_to, {})
             # resolve the member-mode env knob HERE, per dispatch: jit
             # keys on the static mode arg, so passing None would bake
             # the first dispatch's VPROXY_TPU_FP_MEMBER into the cache
@@ -285,14 +670,17 @@ class HintMatcher:
             from ..parallel import mesh as M
             from ..parallel.mesh import query_shards
             n = len(hints)
-            cap = pad_batch(n, query_shards(self._mesh))
-            padded = list(hints) + [Hint()] * (cap - n)
+            cap = pad_batch(max(n, pad_to or 0), query_shards(self._mesh))
             if self.backend == "jax-fp-sharded":
                 from ..ops import fphash as F
+                padded = list(hints) + [Hint()] * (cap - n)
                 q = F.encode_hint_queries_fp_sharded(padded, tab)
                 kernel = F.hint_fp_match
             else:
-                q = H.encode_hint_queries_sharded(padded, tab)
+                # single-pass multi-salt encode: one rolling-hash pass
+                # serves every shard (the old path re-encoded per shard
+                # — 8x the host cost of the whole dispatch)
+                q = H.encode_hint_queries_sharded(hints, tab, pad_to=cap)
                 kernel = None
             qd = M.shard_hint_queries_sharded(q, self._mesh)
             if self._fn is None:
@@ -300,9 +688,15 @@ class HintMatcher:
                     self._mesh, {k: v.ndim for k, v in tab.arrays.items()},
                     {k: v.ndim for k, v in q.items()}, kernel=kernel)
             out = self._fn(dev, qd, np.int32(tab.shard_size))
+            if not sync:
+                import jax
+                if jax.process_count() <= 1:
+                    return out  # async: caller syncs + slices
             # to_local: this process's slice on a multi-process mesh,
             # plain np.asarray single-process
             return M.to_local(out)[:n]
+        if pad_to and pad_to > len(hints):
+            hints = list(hints) + [Hint()] * (pad_to - len(hints))
         q = T.encode_hints(hints)
         idx, _ = hint_match_jit(
             dev, q["host"], q["has_host"], unpack_bits(q["uri"]),
@@ -312,6 +706,8 @@ class HintMatcher:
 
 class CidrMatcher:
     """Device-backed ordered first-match CIDR matcher (routes / ACL)."""
+
+    _kind = "cidr"
 
     def __init__(self, networks: Sequence = (), backend: Optional[str] = None,
                  acl: Optional[Sequence[AclRule]] = None, payload=None,
@@ -324,19 +720,48 @@ class CidrMatcher:
         self._tab = None   # jax-sharded stacked table meta
         self._mesh = mesh  # jax-sharded only (lazily defaulted)
         self._fns: dict = {}  # jax-sharded jitted fns keyed by with_port
+        self.generation = 0  # bumps on every publish (atomic swap)
         # (dev, nets, acl, payload, tab, index) — one atomic generation
         # (see HintMatcher._pub for the why)
         self._pub: tuple = (None, [], None, payload, None, None)
         self._payload = payload
         self._cksum = None  # (pub-tuple, crc32) cache — see checksum()
         self._recompile()
+        with _gen_lock:
+            _MATCHERS.add(self)
 
     def set_networks(self, networks: Sequence, acl: Optional[Sequence[AclRule]] = None,
-                     payload=None) -> None:
+                     payload=None, wait: bool = True) -> None:
+        """Install a new generation via the background TableInstaller
+        (see HintMatcher.set_rules — same standby-swap contract)."""
+        t = TableInstaller.get().submit(
+            self, (list(networks),
+                   list(acl) if acl is not None else None, payload))
+        if wait:
+            t.ev.wait()
+            if t.exc is not None:
+                raise t.exc
+
+    def _install(self, args: tuple) -> None:
+        """See HintMatcher._install — transactional standby compile."""
+        networks, acl, payload = args
+        old = (self._nets, self._acl, self._payload, self._tab,
+               self._dev, self._caps)
         self._nets = list(networks)
         self._acl = list(acl) if acl is not None else None
         self._payload = payload
-        self._recompile()
+        try:
+            self._recompile()
+        except BaseException:
+            (self._nets, self._acl, self._payload, self._tab,
+             self._dev, self._caps) = old
+            raise
+
+    def published_table_bytes(self) -> int:
+        dev = self._pub[0]
+        if not dev:
+            return 0
+        return int(sum(getattr(v, "nbytes", 0) for v in dev.values()))
 
     def _recompile(self) -> None:
         if self.backend == "jax":
@@ -371,6 +796,7 @@ class CidrMatcher:
                                             acl=self._acl)
             self._caps = self._tab.shards[0].caps
             self._dev = M.shard_hash_table(self._tab, self._mesh)
+            M.release_host(self._tab)  # memory-lean: see HintMatcher
             # _fns kept: see HintMatcher._recompile
         elif self.backend == "jax-dense":
             cap = self._dev["allow"].shape[0] if self._dev is not None else None
@@ -382,9 +808,14 @@ class CidrMatcher:
         if len(self._nets) > SMALL_TABLE:  # every backend: see HintMatcher
             from .index import CidrIndex
             idx = CidrIndex(self._nets, acl=self._acl)
+        _sync_standby(self._dev)
+        time.sleep(0)  # preemption point between compile and publish
         self._pub = (self._dev, list(self._nets),
                      None if self._acl is None else list(self._acl),
                      self._payload, self._tab, idx)
+        self.generation += 1
+        with _gen_lock:
+            _GENERATION[0] += 1
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
@@ -404,7 +835,8 @@ class CidrMatcher:
         return self.oracle_snap(self._pub, addr, port)
 
     def match_one(self, addr: bytes, port: Optional[int] = None) -> int:
-        if self.backend != "host" and len(self._nets) <= SMALL_TABLE:
+        # published-generation gate: see HintMatcher.match_one
+        if self.backend != "host" and len(self._pub[1]) <= SMALL_TABLE:
             return self._scan_one(addr, port)
         return int(self.match([addr], None if port is None else [port])[0])
 
@@ -451,6 +883,7 @@ class CidrMatcher:
                    port: Optional[int] = None) -> int:
         """O(groups) host lookup against the snapshot's CidrIndex (same
         winner as oracle_snap); linear fallback without one."""
+        note_serving()
         idx = snap[5] if len(snap) > 5 else None
         if idx is None:
             return self.oracle_snap(snap, addr, port)
@@ -458,9 +891,14 @@ class CidrMatcher:
         return idx.lookup(addr, None if snap[2] is None else port)
 
     def dispatch_snap(self, snap: tuple, addrs: Sequence[bytes],
-                      ports: Optional[Sequence[int]]):
+                      ports: Optional[Sequence[int]],
+                      pad_to: Optional[int] = None, sync: bool = True):
         """Encode + submit one batch against the snapshotted table
-        generation (async device result; np.asarray() it to block)."""
+        generation (async device result; np.asarray() it to block).
+        pad_to: pad the encoded arrays to this batch bucket (family -1
+        marks pad rows — matches no group, walks no trie). sync: see
+        HintMatcher.dispatch_snap."""
+        note_serving()
         dev, nets, acl = snap[0], snap[1], snap[2]
         if not nets or not addrs:
             return np.full(len(addrs), -1, np.int32)
@@ -469,17 +907,25 @@ class CidrMatcher:
         # gate must be skipped entirely or every port>0 query misses
         p = None if (ports is None or acl is None) \
             else np.asarray(ports, np.int32)
+        if pad_to and pad_to > a16.shape[0]:
+            k = pad_to - a16.shape[0]
+            a16 = np.concatenate([a16, np.zeros((k,) + a16.shape[1:],
+                                                a16.dtype)])
+            fam = np.concatenate([fam, np.full(k, -1, fam.dtype)])
+            if p is not None:
+                p = np.concatenate([p, np.zeros(k, p.dtype)])
         if self.backend == "jax":
             return H.cidr_hash_jit(dev, a16, fam, p)
         if self.backend == "jax-fp":
             from ..ops import fphash as F
             return F.cidr_fp_jit(dev, a16, fam, p)
         if self.backend in ("jax-sharded", "jax-fp-sharded"):
-            return self._dispatch_sharded(snap, a16, fam, p)
+            return self._dispatch_sharded(snap, a16, fam, p, sync=sync)
         return cidr_match_jit(dev, a16, fam, p)
 
     def _dispatch_sharded(self, snap: tuple, a16: np.ndarray,
-                          fam: np.ndarray, p: Optional[np.ndarray]):
+                          fam: np.ndarray, p: Optional[np.ndarray],
+                          sync: bool = True):
         from ..parallel import mesh as M
         dev, tab = snap[0], snap[4]
         from ..parallel.mesh import query_shards
@@ -505,4 +951,8 @@ class CidrMatcher:
         size = np.int32(tab.shard_size)
         out = fn(dev, a16d, famd, pd, size) if with_port \
             else fn(dev, a16d, famd, size)
+        if not sync:
+            import jax
+            if jax.process_count() <= 1:
+                return out  # async: caller syncs + slices
         return M.to_local(out)[:n]

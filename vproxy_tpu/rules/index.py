@@ -44,7 +44,11 @@ class HintIndex:
         wh: list[int] = []
         wu: list[int] = []
         lset = set()
+        from ..ops.cuckoo import coop_yield
         for i, r in enumerate(self.rules):
+            if not (i & 31):
+                coop_yield()  # cooperative: builds run on the engine's
+                #               background installer (cuckoo.coop_yield)
             if r.is_empty():
                 continue
             if r.host is not None:
@@ -58,10 +62,14 @@ class HintIndex:
                     wu.append(i)
         # identical pruning signatures as the device table compilers —
         # the exactness argument is ops/hashmatch.py:166-181 verbatim
-        for k in self.host_buckets:
+        for bi, k in enumerate(self.host_buckets):
+            if not (bi & 63):
+                coop_yield()
             self.host_buckets[k] = _prune_list(
                 self.rules, self.host_buckets[k], lambda r: (r.uri, r.port))
-        for k in self.uri_buckets:
+        for bi, k in enumerate(self.uri_buckets):
+            if not (bi & 63):
+                coop_yield()
             self.uri_buckets[k] = _prune_list(
                 self.rules, self.uri_buckets[k], lambda r: r.port)
         self.wh = _prune_list(self.rules, wh, lambda r: (r.uri, r.port))
@@ -116,7 +124,10 @@ class CidrIndex:
         self.groups: dict[tuple, dict] = {}
         self.acl = list(acl) if acl is not None else None
         buckets: dict[tuple, dict[int, list[int]]] = {}
+        from ..ops.cuckoo import coop_yield
         for i, net in enumerate(networks):
+            if not (i & 31):
+                coop_yield()  # cooperative: see HintIndex.__init__
             for key, mask, fam in _expand_patterns(net):
                 g = buckets.setdefault(
                     (fam, int.from_bytes(mask, "big")), {})

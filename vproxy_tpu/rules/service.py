@@ -63,8 +63,23 @@ serves that batch and everything after it from the host oracle, and
 re-probes the device every RETRY_S seconds. Accepts never die with a
 classify backtrace.
 
-Batch shapes are padded to power-of-two buckets (min 16) so the jitted
-matchers compile a handful of programs, not one per batch size.
+Batch shapes are padded to power-of-two buckets (min VPROXY_TPU_PAD_LO,
+default 4) so the jitted matchers compile a handful of programs, not
+one per batch size. Padding is ARRAY-level (engine dispatch_snap
+pad_to): only the real queries pay the host-side encode; pad rows are
+invalid-probe fills that can never match.
+
+The dispatcher is DOUBLE-BUFFERED (round 8) for cheap-dispatch
+backends: a device batch is submitted asynchronously, and the
+dispatcher goes straight back to draining the queue — the next
+batch's encode overlaps the previous batch's device compute, and the
+previous result is pulled (one host round trip per batch) just before
+delivery. A straggler that missed batch k no longer waits out k's
+full round trip before k+1 even starts; that wait was THE
+service_device_p99 driver (BENCH_r06). Mesh-SHARDED backends instead
+submit synchronously (see _device_submit): their per-dispatch cost is
+fixed and high, so parking the dispatcher through the round trip —
+the "natural batching" window above — beats the overlap (A/B'd).
 
 Callbacks are delivered on the submitting event loop via run_on_loop()
 (loop-confinement discipline, SURVEY §5 race-detection row); submissions
@@ -86,6 +101,7 @@ from .ir import Hint
 _log = Logger("classify")
 
 RETRY_S = float(os.environ.get("VPROXY_TPU_DEVICE_RETRY_S", "5"))
+PAD_LO = int(os.environ.get("VPROXY_TPU_PAD_LO", "4"))
 BUDGET_US = float(os.environ.get("VPROXY_TPU_CLASSIFY_BUDGET_US", "5000"))
 INLINE_LONE = os.environ.get("VPROXY_TPU_INLINE_LONE", "1") != "0"
 PROBE_EVERY = 32     # re-probe the non-preferred lone-query path
@@ -120,6 +136,23 @@ class _Req:
         self.cb = cb
         self.loop = loop
         self.t0 = time.monotonic()
+
+
+class _Inflight:
+    """One async-submitted device batch awaiting its sync + delivery
+    (the dispatcher's double buffer slot)."""
+
+    __slots__ = ("kind", "matcher", "reqs", "snap", "arr", "t0",
+                 "lone_big")
+
+    def __init__(self, kind, matcher, reqs, snap, arr, t0, lone_big):
+        self.kind = kind
+        self.matcher = matcher
+        self.reqs = reqs
+        self.snap = snap
+        self.arr = arr
+        self.t0 = t0
+        self.lone_big = lone_big
 
 
 class ClassifyStats:
@@ -413,32 +446,54 @@ class ClassifyService:
     # ---------------------------------------------------------- dispatcher
 
     def _run(self) -> None:
+        # double-buffered: at most ONE device batch in flight while the
+        # next one encodes/submits; the in-flight result syncs just
+        # before its delivery (one host round trip per batch)
+        inflight: Optional[_Inflight] = None
         while True:
             with self._cv:
-                while not self._pending and not self._closed:
+                while not self._pending and not self._closed \
+                        and inflight is None:
                     self._cv.wait()
-                if self._closed and not self._pending:
-                    return
                 batches = list(self._pending.values())
                 self._pending.clear()
+                closed = self._closed
+            if not batches:
+                if inflight is not None:
+                    self._finish_guarded(inflight)
+                    inflight = None
+                    continue
+                if closed:
+                    return
+                continue
             for kind, matcher, reqs in batches:
-                try:
-                    self._dispatch(kind, matcher, reqs)
-                except MemoryError:
-                    raise  # OOM contract: log-then-die, not limp (utils/oom)
-                except Exception:
-                    # the dispatcher thread must survive ANY per-batch
-                    # error (incl. oracle/delivery bugs) — a dead thread
-                    # would strand every future classify silently.
-                    # Callbacks get -1 ("no match") so callers proceed.
-                    _log.error("classify dispatch failed; delivering "
-                               "no-match to batch", exc=True)
+                for part in self._split_uniform(kind, reqs):
+                    nxt = None
                     try:
-                        self._deliver(reqs, [-1] * len(reqs))
+                        nxt = self._begin_uniform(kind, matcher, part)
                     except MemoryError:
-                        raise
+                        raise  # OOM contract: log-then-die (utils/oom)
                     except Exception:
-                        _log.error("classify delivery failed", exc=True)
+                        # the dispatcher thread must survive ANY
+                        # per-batch error (incl. oracle/delivery bugs)
+                        # — a dead thread would strand every future
+                        # classify silently. Callbacks get -1 ("no
+                        # match") so callers proceed.
+                        _log.error("classify dispatch failed; delivering "
+                                   "no-match to batch", exc=True)
+                        try:
+                            self._deliver(part, [-1] * len(part))
+                        except MemoryError:
+                            raise
+                        except Exception:
+                            _log.error("classify delivery failed",
+                                       exc=True)
+                    if inflight is not None:
+                        # deliver the PREVIOUS batch now that the next
+                        # one is already on the device
+                        self._finish_guarded(inflight)
+                        inflight = None
+                    inflight = nxt
 
     def _use_device(self, matcher, n: int) -> bool:
         if self.mode == "host" or getattr(matcher, "backend", "host") == "host":
@@ -472,7 +527,8 @@ class ClassifyService:
             cur = self._ewma[path]
             self._ewma[path] = us if cur is None else 0.8 * cur + 0.2 * us
 
-    def _dispatch(self, kind: str, matcher, reqs: list[_Req]) -> None:
+    @staticmethod
+    def _split_uniform(kind: str, reqs: list[_Req]) -> list[list[_Req]]:
         if kind == "cidr":
             # port=None means "ignore port ranges" and must NOT share a
             # device batch with port-carrying queries (it would be coerced
@@ -480,66 +536,132 @@ class ClassifyService:
             with_p = [r for r in reqs if r.payload[1] is not None]
             without = [r for r in reqs if r.payload[1] is None]
             if with_p and without:
-                self._dispatch_uniform(kind, matcher, with_p)
-                self._dispatch_uniform(kind, matcher, without)
-                return
-        self._dispatch_uniform(kind, matcher, reqs)
+                return [with_p, without]
+        return [reqs]
 
-    def _dispatch_uniform(self, kind: str, matcher, reqs: list[_Req]) -> None:
+    def _begin_uniform(self, kind: str, matcher,
+                       reqs: list[_Req]) -> Optional["_Inflight"]:
+        """Submit one uniform batch: device batches go out ASYNC and
+        return an _Inflight for _finish_inflight to sync+deliver; host
+        batches deliver here and return None."""
         n = len(reqs)
         with self.stats.lock:  # inline submit threads write stats too
             self.stats.max_batch = max(self.stats.max_batch, n)
         snap = matcher.snapshot()  # ONE generation for device/oracle/payload
         lone_big = n == 1 and matcher.size() > SMALL_TABLE
-        idxs = None
         if self._use_device(matcher, n):
             try:
                 t0 = time.monotonic()
-                idxs = self._device_batch(kind, matcher, snap, reqs)
-                if lone_big:
-                    self._note_lone_latency("device", time.monotonic() - t0)
-                with self.stats.lock:
-                    self.stats.dispatches += 1
-                    self.stats.device_queries += n
+                arr = self._device_submit(kind, matcher, snap, reqs)
+                return _Inflight(kind, matcher, reqs, snap, arr, t0,
+                                 lone_big)
             except MemoryError:
                 raise
             except Exception as e:
-                self.stats.bump("failovers")
-                self._device_down_until = time.monotonic() + self.retry_s
-                _log.alert(f"device classify failed ({e!r}); serving from "
-                           f"host oracle, retry in {self.retry_s:.0f}s")
-                from ..utils import events
-                events.record("classify_failover",
-                              f"device classify failed: {e!r}",
-                              batch=n, retry_s=self.retry_s)
+                self._device_failed(e, n)
+        t0 = time.monotonic()
+        idxs = self._oracle_batch(kind, matcher, snap, reqs)
+        if lone_big:
+            self._note_lone_latency("oracle", time.monotonic() - t0)
+        self.stats.bump("oracle_queries", n)
+        self._deliver(reqs, idxs, matcher.snap_payload(snap))
+        return None
+
+    def _finish_guarded(self, inf: "_Inflight") -> None:
+        """_finish_inflight behind the dispatcher's survival guard: the
+        thread must outlive ANY per-batch error (incl. oracle/delivery
+        bugs) — a dead dispatcher would strand every future classify
+        silently. Callbacks get -1 ("no match") so callers proceed."""
+        try:
+            self._finish_inflight(inf)
+        except MemoryError:
+            raise  # OOM contract: log-then-die, not limp (utils/oom)
+        except Exception:
+            _log.error("classify finish failed; delivering no-match "
+                       "to batch", exc=True)
+            try:
+                self._deliver(inf.reqs, [-1] * len(inf.reqs))
+            except MemoryError:
+                raise
+            except Exception:
+                _log.error("classify delivery failed", exc=True)
+
+    def _finish_inflight(self, inf: "_Inflight") -> None:
+        """Pull one in-flight device batch (the single host round trip)
+        and deliver; a device error here degrades THIS batch to the
+        oracle and marks the device down, same as a submit failure."""
+        n = len(inf.reqs)
+        idxs = None
+        try:
+            idxs = np.asarray(inf.arr)[:n]
+            if inf.lone_big:
+                self._note_lone_latency("device", time.monotonic() - inf.t0)
+            with self.stats.lock:
+                self.stats.dispatches += 1
+                self.stats.device_queries += n
+        except MemoryError:
+            raise
+        except Exception as e:
+            self._device_failed(e, n)
         if idxs is None:
             t0 = time.monotonic()
-            idxs = self._oracle_batch(kind, matcher, snap, reqs)
-            if lone_big:
+            idxs = self._oracle_batch(inf.kind, inf.matcher, inf.snap,
+                                      inf.reqs)
+            if inf.lone_big:
                 self._note_lone_latency("oracle", time.monotonic() - t0)
             self.stats.bump("oracle_queries", n)
-        self._deliver(reqs, idxs, matcher.snap_payload(snap))
+        try:
+            self._deliver(inf.reqs, idxs,
+                          inf.matcher.snap_payload(inf.snap))
+        except MemoryError:
+            raise
+        except Exception:
+            _log.error("classify delivery failed", exc=True)
 
-    def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
+    def _device_failed(self, e: Exception, n: int) -> None:
+        self.stats.bump("failovers")
+        self._device_down_until = time.monotonic() + self.retry_s
+        _log.alert(f"device classify failed ({e!r}); serving from "
+                   f"host oracle, retry in {self.retry_s:.0f}s")
+        from ..utils import events
+        events.record("classify_failover",
+                      f"device classify failed: {e!r}",
+                      batch=n, retry_s=self.retry_s)
+
+    def _device_submit(self, kind: str, matcher, snap, reqs: list[_Req]):
+        """Encode + submit (NO sync): returns the async device result.
+        Only the real queries are encoded — the engine pads the arrays
+        to the batch bucket with can-never-match fill rows."""
         from ..utils import failpoint
         if failpoint.hit("device.dispatch.error", kind):
             # injected device fault: exercises the host-oracle failover
             # (and the down-until/re-probe machinery) deterministically
             raise RuntimeError("failpoint device.dispatch.error")
         n = len(reqs)
-        cap = pad_batch(n)
+        cap = pad_batch(n, lo=PAD_LO)
+        # dispatch-cost policy (A/B'd, BENCH_r08): cheap single-device
+        # dispatches PIPELINE (async submit — straggler overlap is the
+        # r06->r08 service p99 win, 2.3ms -> 1.5ms), while mesh-sharded
+        # dispatches PARK the dispatcher (sync): their fixed
+        # per-dispatch cost is high enough that the natural-batching
+        # window matters more than overlap (sharded closed-loop p50
+        # 3.4ms sync vs 5.9ms async — async halves the batch size)
+        sync = getattr(matcher, "backend", "host") in (
+            "jax-sharded", "jax-fp-sharded")
         if kind == "hint":
-            hints = [r.payload for r in reqs]
-            hints += [Hint()] * (cap - n)
-            return np.asarray(matcher.dispatch_snap(snap, hints))[:n]
+            return matcher.dispatch_snap(snap, [r.payload for r in reqs],
+                                         pad_to=cap, sync=sync)
         addrs = [r.payload[0] for r in reqs]
         ports = [r.payload[1] for r in reqs]
-        addrs += [b"\x00\x00\x00\x00"] * (cap - n)
-        if ports[0] is not None:  # uniform batches only (see _dispatch)
-            ports = ports + [0] * (cap - n)
-        else:
+        if ports[0] is None:  # uniform batches only (see _split_uniform)
             ports = None
-        return np.asarray(matcher.dispatch_snap(snap, addrs, ports))[:n]
+        return matcher.dispatch_snap(snap, addrs, ports, pad_to=cap,
+                                     sync=sync)
+
+    def _device_batch(self, kind: str, matcher, snap, reqs: list[_Req]):
+        """Synchronous submit+pull (the probe worker's path)."""
+        return np.asarray(
+            self._device_submit(kind, matcher, snap, reqs))[: len(reqs)]
 
     def _oracle_batch(self, kind: str, matcher, snap,
                       reqs: list[_Req]) -> list[int]:

@@ -30,6 +30,12 @@ operators, via `add fault` / `remove fault` and `GET /faults`) can arm:
                              the generation gate is what prevents the
                              native flow table forwarding through a
                              stale action after a rule mutation
+    engine.swap.stall        the background standby-table compile
+                             (rules/engine.py TableInstaller) sleeps
+                             VPROXY_TPU_SWAP_STALL_S before publishing:
+                             proves dispatch keeps answering the OLD
+                             generation through a slow install and
+                             flips atomically after
 
 Each armed fault carries three independent gates, all optional:
 
@@ -70,6 +76,7 @@ SITES = (
     "cluster.replicate.torn",
     "cluster.step.stall",
     "switch.flowcache.stale",
+    "engine.swap.stall",
 )
 
 _lock = threading.Lock()

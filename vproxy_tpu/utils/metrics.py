@@ -293,6 +293,17 @@ class GlobalInspection:
             self.registry.gauge_f("vproxy_switch_native_drop_total",
                                   lambda j=j: self._flowcache_counter(5 + j),
                                   reason=r)
+        # classify-engine generation installs (rules/engine.py): total
+        # published generations and the published device-table bytes
+        # per matcher kind; vproxy_engine_swap_ms (install latency) is
+        # get_histogram'd by the TableInstaller on first publish
+        self.registry.gauge_f("vproxy_engine_generation",
+                              self._engine_generation)
+        for kind in ("hint", "cidr"):
+            self.registry.gauge_f(
+                "vproxy_engine_table_bytes",
+                lambda kind=kind: self._engine_table_bytes(kind),
+                matcher=kind)
         # cluster plane (vproxy_tpu/cluster): fleet membership, rule
         # generation convergence, and the step-synchronized dispatch
         # clock — all 0 until a ClusterNode boots
@@ -313,6 +324,18 @@ class GlobalInspection:
         from ..rules.service import ClassifyService
         svc = ClassifyService._instance
         return 0.0 if svc is None else float(getattr(svc.stats, key))
+
+    @staticmethod
+    def _engine_generation() -> float:
+        import sys
+        eng = sys.modules.get("vproxy_tpu.rules.engine")
+        return 0.0 if eng is None else float(eng.generation_total())
+
+    @staticmethod
+    def _engine_table_bytes(kind: str) -> float:
+        import sys  # scrape must not force a jax import
+        eng = sys.modules.get("vproxy_tpu.rules.engine")
+        return 0.0 if eng is None else float(eng.table_bytes_total(kind))
 
     @staticmethod
     def _cluster_stat(key: str) -> float:
