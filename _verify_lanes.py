@@ -1,0 +1,200 @@
+"""Scenario drive: the C accept-lane plane end-to-end through the
+public/operator surfaces (the verify-skill recipe, round 9).
+
+Covers: lanes-on TcpLB built via the Command grammar (`add tcp-lb ...
+lanes 2`), whole-lifetime-in-C serving (python accept counter stays 0),
+`list-detail tcp-lb` lane column + HTTP detail `lanes` object + the
+vproxy_lane_* metrics, generation-gated rerouting on a live upstream
+mutation, connect-failure punts feeding retry/ejection, failpoint
+force-classic, and drain with lane-owned sessions counted.
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_lanes.py
+"""
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import Command
+from vproxy_tpu.control.http_controller import HttpController
+from vproxy_tpu.net import vtl
+from vproxy_tpu.utils import failpoint, lifecycle
+
+
+class IdSrv:
+    def __init__(self, ident):
+        self.ident = ident.encode()
+        self.s = socket.socket()
+        self.s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.s.bind(("127.0.0.1", 0))
+        self.s.listen(64)
+        self.port = self.s.getsockname()[1]
+        self.hits = 0
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                c, _ = self.s.accept()
+            except OSError:
+                return
+            self.hits += 1
+            threading.Thread(target=self._serve, args=(c,),
+                             daemon=True).start()
+
+    def _serve(self, c):
+        try:
+            c.sendall(self.ident)
+            while True:
+                d = c.recv(4096)
+                if not d:
+                    break
+                c.sendall(d)
+        except OSError:
+            pass
+        finally:
+            c.close()
+
+
+def get_id(port):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    sid = c.recv(16)
+    c.close()
+    return sid.decode()
+
+
+def wait_for(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def main():
+    assert vtl.lanes_supported(), "native lanes unavailable"
+    print(f"# uring probe: {vtl.uring_probe_fields()}")
+    lifecycle.reset()
+    app = Application.create(workers=2)
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    a, b = IdSrv("A"), IdSrv("B")
+    try:
+        # build the whole stack through the command grammar
+        for cmd in (
+                "add upstream u0",
+                "add server-group g0 timeout 500 period 100 up 1 down 1",
+                "add server-group g0 to upstream u0 weight 10",
+                f"add server sA to server-group g0 address "
+                f"127.0.0.1:{a.port} weight 10"):
+            assert Command.execute(app, cmd) == "OK", cmd
+        g = app.server_groups["g0"]
+        assert wait_for(lambda: all(s.healthy for s in g.servers))
+        assert Command.execute(
+            app, "add tcp-lb lb0 address 127.0.0.1:0 upstream u0 "
+            "protocol tcp lanes 2") == "OK"
+        lb = app.tcp_lbs["lb0"]
+        assert lb.lanes is not None, "lanes did not come up"
+        print(f"# lb0 on 127.0.0.1:{lb.bind_port} "
+              f"engine={lb.lanes.engine()}")
+
+        # ---- whole lifetime in C
+        for _ in range(25):
+            assert get_id(lb.bind_port) == "A"
+        assert lb.accepted == 0, "python accept path fired"
+        assert wait_for(lambda: lb.lanes.stat()["served"] >= 25)
+        print(f"# 25/25 served in C, python accepts = {lb.accepted}")
+
+        # ---- operator surfaces agree
+        detail = Command.execute(app, "list-detail tcp-lb")
+        assert any("lanes on(n=2,engine=" in d for d in detail), detail
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl.bind_port}/api/v1/module/tcp-lb",
+                timeout=5) as r:
+            doc = json.loads(r.read())
+        lanes_obj = doc[0]["lanes"]
+        assert lanes_obj["on"] and lanes_obj["served"] >= 25, lanes_obj
+        assert set(lanes_obj["uring_probe"]) == {
+            "setup", "accept", "connect", "poll", "splice", "send_zc"}
+        from vproxy_tpu.utils.metrics import GlobalInspection
+        snap = GlobalInspection.get().bench_snapshot()
+        assert snap.get("vproxy_lane_served_total", 0) >= 25, \
+            {k: v for k, v in snap.items() if "lane" in k}
+        print(f"# list-detail + HTTP lanes object + metrics agree: "
+              f"served={lanes_obj['served']} hit_rate={lanes_obj['hit_rate']}")
+
+        # ---- generation gate: live mutation reroutes, zero stale
+        assert Command.execute(
+            app, f"add server sB to server-group g0 address "
+            f"127.0.0.1:{b.port} weight 10") == "OK"
+        assert wait_for(lambda: all(s.healthy for s in g.servers))
+        assert wait_for(lambda: get_id(lb.bind_port) == "B")
+        hits_a = a.hits
+        assert Command.execute(
+            app, "remove server sA from server-group g0") == "OK"
+        for _ in range(10):
+            assert get_id(lb.bind_port) == "B"
+        assert a.hits == hits_a, "stale handover to a removed backend"
+        print("# mutation gate: sA removed mid-traffic, 10/10 -> B, "
+              "zero stale")
+
+        # ---- connect-fail punt -> retry: a dead listener joins
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead.listen(4)
+        dport = dead.getsockname()[1]
+        assert Command.execute(
+            app, f"add server sDead to server-group g0 address "
+            f"127.0.0.1:{dport} weight 10") == "OK"
+        assert wait_for(lambda: all(s.healthy for s in g.servers))
+        dead.close()
+        ok = sum(1 for _ in range(20) if get_id(lb.bind_port) == "B")
+        assert ok >= 19, ok
+        assert vtl.lane_counters()[4] > 0, "no connect-fail punts seen"
+        print(f"# dead backend mid-entry: {ok}/20 served via punt+retry, "
+              f"punt_fail={vtl.lane_counters()[4]}")
+        Command.execute(app, "remove server sDead from server-group g0")
+
+        # ---- armed failpoint forces the classic path
+        assert Command.execute(
+            app, "add fault backend.connect.refuse match nothing-ever"
+        ) == "OK"
+        assert get_id(lb.bind_port) == "B"
+        assert lb.accepted == 1, lb.accepted
+        assert Command.execute(
+            app, "remove fault backend.connect.refuse") == "OK"
+        served0 = lb.lanes.stat()["served"]
+        assert wait_for(lambda: (get_id(lb.bind_port) == "B"
+                                 and lb.lanes.stat()["served"] > served0))
+        print("# armed fault -> classic path, disarm -> lanes resume")
+
+        # ---- drain: lane session counted, listeners close, completes
+        hold = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                        timeout=5)
+        hold.settimeout(5)
+        assert hold.recv(1) == b"B"
+        assert wait_for(lambda: app.sessions_in_flight() >= 1)
+        assert Command.execute(app, "drain") == "OK"
+        assert app.drain_wait(0) is False  # held open by the lane session
+        hold.sendall(b"alive")
+        assert hold.recv(16) == b"alive"
+        hold.close()
+        assert app.drain_wait(10) is True
+        print("# drain: lane session held it open, completed after close")
+        print("VERIFY_LANES_OK")
+    finally:
+        failpoint.clear()
+        try:
+            ctl.stop()
+        except Exception:
+            pass
+        app.close()
+        lifecycle.reset()
+
+
+if __name__ == "__main__":
+    main()

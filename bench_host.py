@@ -36,9 +36,33 @@ Round-6 additions (docs/perf.md):
   kernel's own no-LB connect/accept cycle). TCP_DEFER_ACCEPT is
   enabled on the LB listeners for all rows (client-speaks-first).
 
+Round-9 additions (docs/perf.md, ISSUE 8):
+
+* C accept-lane A/B — the short row runs lanes-off (the r6 C
+  connect+pump fast lane) and lanes-on (vtl.cpp accept lanes: the WHOLE
+  short-connection lifetime in C). The io_uring probe result rides the
+  artifact (`host_uring_probe`, `host_lane_engine`) so it is honest
+  about which completion engine ran — this container's 4.4 kernel
+  denies io_uring and the lanes run the epoll engine.
+* GIL-contention A/B — the same rows with one python thread doing
+  CPU-bound work (standing in for on-host classify/compile load, the
+  production state of a vproxy-tpu node): the python accept path
+  collapses (every accept waits on the GIL), the lanes hold. This is
+  the displacement the lanes buy; `host_lanes_gil_speedup` is the
+  headline ratio.
+* kernel-serialization evidence — two direct short benches run in
+  PARALLEL against separate servers sum to the same rate as one
+  (`host_direct_short_2x_sum` ~ `host_direct_short_rps`): this
+  container class serializes ALL connection setup in the sandbox
+  kernel, which pins the uncontended LB short row near 0.5x of direct
+  (2 connects + 2 accepts per request vs 1 + 1) regardless of
+  accept-plane parallelism.
+* `--lanes` runs ONLY the lane stage (BENCH_r09_builder_lanes.json).
+
 Env knobs: HOSTBENCH_CONNS (64), HOSTBENCH_SECS (8), HOSTBENCH_PIPELINE
 (4), HOSTBENCH_BACKENDS (2), HOSTBENCH_WORKERS (4), HOSTBENCH_POOL
-(32), HOSTBENCH_CANARY_MB (1024), HOSTBENCH_DEFER_ACCEPT (1).
+(32), HOSTBENCH_CANARY_MB (1024), HOSTBENCH_DEFER_ACCEPT (1),
+HOSTBENCH_LANES (4).
 """
 import json
 import os
@@ -143,6 +167,11 @@ def main():
     # otherwise the native server processes are orphaned forever
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
+    # --lanes: run ONLY the accept-lane stage (direct ceiling +
+    # serialization evidence + lanes on/off + GIL-contention A/B) —
+    # the BENCH_r09_builder_lanes.json artifact
+    lanes_only = "--lanes" in sys.argv[1:]
+
     conns = _env_int("HOSTBENCH_CONNS", 64)
     secs = float(os.environ.get("HOSTBENCH_SECS", "8"))
     pipeline = _env_int("HOSTBENCH_PIPELINE", 4)
@@ -188,9 +217,42 @@ def main():
         # cost on this kernel alone — the denominator that makes the LB
         # short row comparable across machines (sandboxed kernels have
         # been measured 5-6x slower per accept cycle than bare metal)
-        r = run_client(backends[0], conns, max(2.0, secs / 2), 1,
-                       short=True)
-        result["host_direct_short_rps"] = r["rps"]
+        # median-of-3: the denominator of host_short_vs_ceiling must
+        # not ride one sample's ambient-load luck
+        dsr = sorted(run_client(backends[0], conns, max(2.0, secs / 2),
+                                1, short=True)["rps"] for _ in range(3))
+        result["host_direct_short_rps"] = dsr[1]
+        result["host_direct_short_reps"] = dsr
+        flush()
+
+        # kernel-serialization evidence: two direct short benches run
+        # in PARALLEL against separate servers. On this container class
+        # the sum lands at ~one bench's rate — the sandbox kernel
+        # serializes all connection setup machine-wide, which is what
+        # pins any LB short row (2 connects + 2 accepts per request)
+        # near 0.5x of direct no matter how parallel the accept plane.
+        if len(backends) >= 2:
+            par_out = [None, None]
+
+            def _par_short(i, port):
+                par_out[i] = run_client(port, conns, 3.0, 1, short=True)
+
+            ts = [threading.Thread(target=_par_short, args=(i, backends[i]))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if par_out[0] and par_out[1]:
+                two_x = round(par_out[0]["rps"] + par_out[1]["rps"], 1)
+                result["host_direct_short_2x_sum"] = two_x
+                scaling = round(
+                    two_x / max(1.0, result["host_direct_short_rps"]), 3)
+                # a parallel-capable kernel doubles (~2.0x); this
+                # container class measures ~1.1-1.4x — connection setup
+                # is substantially serialized machine-wide
+                result["host_direct_short_2x_scaling"] = scaling
+                result["host_kernel_serialized"] = bool(scaling < 1.6)
         flush()
 
         from vproxy_tpu.components.elgroup import EventLoopGroup
@@ -205,10 +267,12 @@ def main():
 
         # fixed canary FIRST: what the machine's splice path is worth
         # this run, before any LB row can be mis-attributed to code
-        canary = splice_canary(elg, _env_int("HOSTBENCH_CANARY_MB", 1024))
-        if canary is not None:
-            result["host_canary_MBps"] = canary
-        flush()
+        if not lanes_only:
+            canary = splice_canary(elg,
+                                   _env_int("HOSTBENCH_CANARY_MB", 1024))
+            if canary is not None:
+                result["host_canary_MBps"] = canary
+            flush()
 
         hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1, down=2)
         g = ServerGroup("g", elg, hc, "wrr")
@@ -229,8 +293,9 @@ def main():
         ups = Upstream("u")
         ups.add(g, annotations=HintRule(host="bench.example.com"))
 
-        for mode, key in (("tcp", "host_tcp_rps"),
-                          ("http-splice", "host_http_rps")):
+        for mode, key in (() if lanes_only else
+                          (("tcp", "host_tcp_rps"),
+                           ("http-splice", "host_http_rps"))):
             lb = TcpLB(f"lb-{mode}", acceptor, elg, "127.0.0.1", 0, ups,
                        protocol=mode)
             lb.start()
@@ -257,17 +322,21 @@ def main():
             return GlobalInspection.get().get_counter(
                 "vproxy_lb_pool_total", lb=alias, result=res).value()
 
-        for variant, pool_sz, key in (("nopool", 0,
-                                       "host_tcp_short_nopool_rps"),
-                                      ("pool", pool_n,
-                                       "host_tcp_short_pool_rps")):
+        lanes_n = _env_int("HOSTBENCH_LANES", 4)
+        from vproxy_tpu.net import vtl as _v
+        result["host_uring_probe"] = _v.uring_probe_fields()
+        result["host_lanes"] = lanes_n
+        variants = [("nopool", 0, 0, "host_tcp_short_nopool_rps")]
+        if not lanes_only:
+            variants.append(("pool", pool_n, 0, "host_tcp_short_pool_rps"))
+        for variant, pool_sz, n_lanes, key in variants:
             # acceptor group == worker group for the short rows: accepts
             # spread over every loop's REUSEPORT listener and sessions
             # are served where they were accepted — one cross-loop hop
             # fewer per connection (measured +12% on the short row)
             lb = TcpLB(f"lb-short-{variant}", elg, elg,
                        "127.0.0.1", 0, ups, protocol="tcp",
-                       pool_size=pool_sz)
+                       pool_size=pool_sz, lanes=n_lanes)
             lb.start()
             try:
                 # warmup primes the classify jit AND the per-loop pools
@@ -284,23 +353,130 @@ def main():
             finally:
                 lb.stop()
                 lb = None
-        # headline = the better configuration: on real-RTT links the warm
-        # pool wins (skips a backend round trip per session); on loopback
-        # or sandboxed-syscall kernels the C fast lane's fresh connect
-        # beats the pool's refill churn — the A/B rows show which and by
-        # how much on THIS machine
+
+        # lanes-off vs lanes-on, MEDIAN OF 3 INTERLEAVED reps (the
+        # BENCH_r08 generation-swap discipline): on this sandboxed
+        # kernel both rows sit inside the serialized-connection-setup
+        # ceiling band, and single samples bounce ±15% with machine
+        # load — interleaving cancels the drift, the median kills the
+        # outlier rep
+        if _v.lanes_supported():
+            ab: dict = {"off": [], "on": []}
+            rep_secs = max(3.0, secs / 2)
+            for _rep in range(3):
+                for side, n_lanes in (("off", 0), ("on", lanes_n)):
+                    lb = TcpLB(f"lb-short-ab-{side}-{_rep}", elg, elg,
+                               "127.0.0.1", 0, ups, protocol="tcp",
+                               lanes=n_lanes)
+                    lb.start()
+                    if side == "on" and lb.lanes is None:
+                        # engine honesty: a fallen-back LB must never
+                        # publish python-accept numbers as a lanes row
+                        lb.stop()
+                        raise RuntimeError(
+                            "lanes failed to come up mid-bench")
+                    try:
+                        run_client(lb.bind_port, min(conns, 8), 1.0, 1,
+                                   short=True)
+                        r = run_client(lb.bind_port, conns, rep_secs, 1,
+                                       short=True)
+                        ab[side].append((r["rps"], r["errors"]))
+                        if side == "on" and lb.lanes is not None:
+                            # engine honesty: which engine REALLY ran
+                            result["host_lane_engine"] = lb.lanes.engine()
+                            st = lb.lanes.stat()
+                            result["host_lane_stat"] = {
+                                k: st.get(k) for k in
+                                ("served", "punts", "punt_stale",
+                                 "punt_connect_fail", "hit_rate")}
+                    finally:
+                        lb.stop()
+                        lb = None
+            med = {s: sorted(x[0] for x in ab[s])[1] for s in ab}
+            result["host_tcp_short_lanes_rps"] = med["on"]
+            result["host_tcp_short_lanes_off_rps"] = med["off"]
+            result["host_tcp_short_lanes_errors"] = sum(
+                x[1] for x in ab["on"])
+            result["host_tcp_short_lanes_off_errors"] = sum(
+                x[1] for x in ab["off"])
+            result["host_tcp_short_lanes_reps"] = {
+                s: [x[0] for x in ab[s]] for s in ab}
+            flush()
+
+        # GIL-contention A/B: one CPU-bound python thread stands in for
+        # on-host classify/compile work (a vproxy-tpu node's production
+        # state). The python accept path pays the GIL per connection;
+        # the C lanes never touch it — this is the displacement win the
+        # lanes buy on any kernel, and the headline ratio on sandboxed
+        # kernels whose serialized connection setup caps the
+        # uncontended row (host_kernel_serialized above).
+        if _v.lanes_supported():
+            gil_stop = threading.Event()
+
+            def _gil_spin():
+                x = 0
+                while not gil_stop.is_set():
+                    for _ in range(10000):
+                        x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+
+            spin = threading.Thread(target=_gil_spin, daemon=True)
+            spin.start()
+            try:
+                for variant, n_lanes, key in (
+                        ("gil-nolanes", 0,
+                         "host_tcp_short_gil_nolanes_rps"),
+                        ("gil-lanes", lanes_n,
+                         "host_tcp_short_gil_lanes_rps")):
+                    lb = TcpLB(f"lb-short-{variant}", elg, elg,
+                               "127.0.0.1", 0, ups, protocol="tcp",
+                               lanes=n_lanes)
+                    lb.start()
+                    if n_lanes and lb.lanes is None:
+                        lb.stop()
+                        raise RuntimeError(
+                            "lanes failed to come up mid-bench (gil row)")
+                    try:
+                        run_client(lb.bind_port, min(conns, 8), 1.0, 1,
+                                   short=True)
+                        r = run_client(lb.bind_port, conns,
+                                       max(3.0, secs / 2), 1, short=True)
+                        result[key] = r["rps"]
+                        result[key.replace("_rps", "_errors")] = \
+                            r["errors"]
+                        flush()
+                    finally:
+                        lb.stop()
+                        lb = None
+            finally:
+                gil_stop.set()
+                spin.join(2)
+            if result.get("host_tcp_short_gil_nolanes_rps"):
+                result["host_lanes_gil_speedup"] = round(
+                    result.get("host_tcp_short_gil_lanes_rps", 0)
+                    / result["host_tcp_short_gil_nolanes_rps"], 3)
+
+        # headline = the best configuration measured THIS run; every
+        # contender is its own first-class row so the artifact shows
+        # which won and by how much on THIS machine
         pool_rps = result.get("host_tcp_short_pool_rps", 0)
         nopool_rps = result.get("host_tcp_short_nopool_rps", 0)
-        best_short = max(pool_rps, nopool_rps)
+        lanes_rps = result.get("host_tcp_short_lanes_rps", 0)
+        best_short = max(pool_rps, nopool_rps, lanes_rps)
         result["host_tcp_short_rps"] = best_short
-        result["host_tcp_short_best"] = ("pool" if pool_rps >= nopool_rps
-                                         else "nopool")
+        result["host_tcp_short_best"] = (
+            "lanes" if best_short == lanes_rps and lanes_rps else
+            "pool" if best_short == pool_rps and pool_rps else "nopool")
         result["host_short_vs_ref_6511"] = round(best_short / 6511.3, 3)
         result["host_short_vs_haproxy_10052"] = round(
             best_short / 10052.0, 3)
-        if nopool_rps:
+        if nopool_rps and pool_rps:
             result["host_short_pool_speedup"] = round(
                 pool_rps / nopool_rps, 3)
+        lanes_off = result.get("host_tcp_short_lanes_off_rps", nopool_rps)
+        if lanes_rps and lanes_off:
+            # the same-run interleaved lanes-on / lanes-off ratio
+            # (uncontended; the GIL ratio above is the contended one)
+            result["host_lanes_speedup"] = round(lanes_rps / lanes_off, 3)
         if result.get("host_direct_short_rps"):
             # the machine-normalized short row: LB cycle vs the kernel's
             # own no-LB connect/accept cycle on the same run
@@ -312,7 +488,7 @@ def main():
         # (SSLWrapRingBuffer-at-engine-speed analog). Contract: within
         # 2x of the plaintext splice rate.
         from vproxy_tpu.net import vtl as _vtl
-        if _vtl.tls_available():
+        if not lanes_only and _vtl.tls_available():
             import tempfile
             d = tempfile.mkdtemp(prefix="hostbench-tls-")
             cert, keyf = os.path.join(d, "c.crt"), os.path.join(d, "c.key")
@@ -342,10 +518,12 @@ def main():
                 lb = None
         # vs the reference's published wrk numbers on ITS hardware —
         # context, not a same-machine comparison
-        result["host_tcp_vs_ref_173k"] = round(
-            result.get("host_tcp_rps", 0) / 173000.0, 3)
-        result["host_http_vs_ref_112k"] = round(
-            result.get("host_http_rps", 0) / 112000.0, 3)
+        if result.get("host_tcp_rps"):
+            result["host_tcp_vs_ref_173k"] = round(
+                result["host_tcp_rps"] / 173000.0, 3)
+        if result.get("host_http_rps"):
+            result["host_http_vs_ref_112k"] = round(
+                result["host_http_rps"] / 112000.0, 3)
 
         # /metrics snapshot: the accept-path span histograms
         # (vproxy_accept_stage_us{stage=...}), the classify latency

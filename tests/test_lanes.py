@@ -1,0 +1,297 @@
+"""C accept lanes: the whole short-connection lifetime in C, generation-
+gated routing (the tests/test_flowcache.py idiom applied to the accept
+plane), connect-failure punts feeding the retry/ejection machinery, and
+the failpoint force-classic rule.
+
+The `lane.entry.stale` failpoint suppresses exactly ONE generation bump,
+proving a stale lane-forward happens iff the gate is suppressed — and
+zero stale handovers otherwise across upstream-rule / ACL / backend-DOWN
+mutations.
+"""
+import socket
+import time
+
+import pytest
+
+from vproxy_tpu.components.servergroup import ServerGroup
+from vproxy_tpu.components.tcplb import TcpLB
+from vproxy_tpu.components.upstream import Upstream
+from vproxy_tpu.net import vtl
+from vproxy_tpu.utils import failpoint
+
+from tests.test_tcplb import (  # noqa: F401
+    IdServer, fast_hc, stack, tcp_get_id, wait_healthy)
+
+pytestmark = pytest.mark.skipif(
+    not vtl.lanes_supported(),
+    reason="native provider without accept-lane symbols")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def _mk(stack, alias, sid="A", lanes=2, **kw):
+    elg = stack["make_elg"](2)
+    srv = IdServer(sid)
+    stack["servers"].append(srv)
+    g = ServerGroup(f"{alias}-g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add(sid.lower(), "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream(f"{alias}-u")
+    ups.add(g)
+    lb = TcpLB(alias, elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=lanes, **kw)
+    stack["lbs"].append(lb)
+    lb.start()
+    return lb, ups, g, srv, elg
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_lane_serves_whole_lifetime_in_c(stack):
+    lb, ups, g, srv, elg = _mk(stack, "lb-lane")
+    assert lb.lanes is not None, "lanes did not come up"
+    assert lb.lanes.engine() in ("epoll", "uring")
+    for _ in range(20):
+        assert tcp_get_id(lb.bind_port) == "A"
+    # every connection ran in C: the python accept path never fired
+    assert lb.accepted == 0
+    assert _wait(lambda: lb.lanes.stat()["served"] >= 20)
+    st = lb.lanes.stat()
+    assert st["on"] and st["accepted"] >= 20 and st["punts"] == 0
+    assert st["hit_rate"] == 1.0
+    # engine honesty: the probe fields ride the stat (BENCH provenance)
+    assert set(st["uring_probe"]) == {"setup", "accept", "connect",
+                                      "poll", "splice", "send_zc"}
+
+
+def test_lane_stale_forward_iff_gate_suppressed(stack):
+    """The flow-cache stale-gate proof, accept-plane edition: removing
+    the only group normally closes the gate synchronously (conns stop
+    reaching A the moment remove() returns); with `lane.entry.stale`
+    suppressing that ONE bump, the lane keeps forwarding to A through
+    the stale entry — stale iff suppressed."""
+    lb, ups, g, srv, elg = _mk(stack, "lb-stale")
+    assert tcp_get_id(lb.bind_port) == "A"
+
+    failpoint.arm("lane.entry.stale", count=1)
+    ups.remove(g)  # the one bump this would fire is suppressed
+    # upstream is now EMPTY, yet the lane still forwards to A: the
+    # suppressed generation bump is the only thing stale routing needs
+    stale = [tcp_get_id(lb.bind_port) for _ in range(5)]
+    assert stale == ["A"] * 5, stale
+    assert failpoint.active() == []  # the count arm drained
+
+    # re-adding the group fires an UNsuppressed bump: entry recompiles
+    ups.add(g)
+    assert _wait(lambda: tcp_get_id(lb.bind_port) == "A")
+
+    # control arm: same mutation without the failpoint = zero stale.
+    # remove() returns only after the bump, so no later conn can ride
+    # the old entry; with the upstream empty the punt path closes them.
+    ups.remove(g)
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(5)
+    assert c.recv(16) == b""  # no backend: closed, never served by A
+    c.close()
+
+
+def test_lane_zero_stale_across_mutation_matrix(stack):
+    """Upstream swap / ACL deny / backend-DOWN: after each mutation
+    call returns, not one lane connection reaches a no-longer-routable
+    backend."""
+    from vproxy_tpu.components.secgroup import SecurityGroup
+    from vproxy_tpu.rules.ir import AclRule, Proto
+    from vproxy_tpu.utils.ip import Network, mask_bytes
+
+    lb, ups, g, srv, elg = _mk(stack, "lb-matrix")
+
+    # --- upstream swap: A out, B in — conns flip, none reach A after
+    srv_b = IdServer("B")
+    stack["servers"].append(srv_b)
+    g2 = ServerGroup("lb-matrix-g2", elg, fast_hc())
+    stack["groups"].append(g2)
+    g2.add("b", "127.0.0.1", srv_b.port)
+    wait_healthy(g2, 1)
+    ups.add(g2)
+    ups.remove(g)
+    hits_a = srv.hits
+    for _ in range(10):
+        assert tcp_get_id(lb.bind_port) == "B"
+    assert srv.hits == hits_a  # zero stale handovers to A
+
+    # --- ACL mutation: a deny rule makes the group non-trivial — the
+    # lane entry compiles EMPTY and the python ACL path denies
+    sg = lb.security_group
+    sg.add_rule(AclRule(
+        "deny-all", Network(bytes(4), mask_bytes(0)), Proto.TCP,
+        0, 65535, False))
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(5)
+    assert c.recv(16) == b""  # denied (closed), never spliced
+    c.close()
+    sg.remove_rule("deny-all")
+    assert _wait(lambda: tcp_get_id(lb.bind_port) == "B")
+
+    # --- backend DOWN: hc detects the dead server, the health edge
+    # bumps the generation, and the recompiled entry routes nothing
+    srv_b.close()
+    assert _wait(lambda: not g2.servers[0].healthy)
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(5)
+    assert c.recv(16) == b""  # no healthy backend anywhere
+    c.close()
+
+
+def test_lane_connect_fail_feeds_retry_and_ejection(stack):
+    """A lane backend that starts refusing surfaces as connect-fail
+    punts: report_failure feeds the ejection streak and the bounded
+    retry re-dials the healthy backend — the client never notices."""
+    lb, ups, g, srv, elg = _mk(stack, "lb-cfail")
+    # second backend: a bare backlog listener (no accept thread — an
+    # IdServer's accept()-blocked thread keeps the kernel socket alive
+    # past close()). hc connect-probes pass against the backlog; close()
+    # then refuses instantly and deterministically.
+    victim = socket.socket()
+    victim.bind(("127.0.0.1", 0))
+    victim.listen(8)
+    vport = victim.getsockname()[1]
+    g.add("v", "127.0.0.1", vport)
+    wait_healthy(g, 2)
+    base_fail = vtl.lane_counters()[4]
+    victim.close()  # refuses from here; hc down detection lags
+    ok = 0
+    for _ in range(20):
+        sid = tcp_get_id(lb.bind_port)
+        assert sid in ("A", ""), sid
+        if sid == "A":
+            ok += 1
+    # every request landed on A (directly or via retry failover)
+    assert ok >= 19, ok
+    # and the lane really did hit the refusing backend and punt
+    assert vtl.lane_counters()[4] > base_fail
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    retr = GlobalInspection.get().get_counter(
+        "vproxy_lb_retries_total", lb="lb-cfail", result="success")
+    assert retr.value() >= 1
+
+
+def test_lane_non_wrr_method_punts(stack):
+    """source-affinity (and wlc) balancing cannot be a static pick
+    sequence: the lane entry compiles EMPTY and every connection takes
+    the python path that owns the configured semantics."""
+    elg = stack["make_elg"](2)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("lb-src-g", elg, fast_hc(), method="source")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("lb-src-u")
+    ups.add(g)
+    lb = TcpLB("lb-src", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=2)
+    stack["lbs"].append(lb)
+    lb.start()
+    assert lb.lanes is not None
+    for _ in range(3):
+        assert tcp_get_id(lb.bind_port) == "A"
+    # every one of them punted to python (source hashing preserved)
+    assert lb.accepted == 3
+    assert lb.lanes.stat()["served"] == 0
+
+
+def test_socks5_never_gets_lanes(stack):
+    """Socks5Server reads protocol='tcp' but speaks RFC 1928 first: the
+    lanes must refuse eligibility or every client's greeting would be
+    raw-spliced to a backend."""
+    from vproxy_tpu.components.socks5 import Socks5Server
+    elg = stack["make_elg"](1)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("s5-g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("s5-u")
+    ups.add(g)
+    s5 = Socks5Server("s5", elg, elg, "127.0.0.1", 0, ups)
+    s5.lanes_n = 4  # as VPROXY_TPU_ACCEPT_LANES=4 would set it
+    stack["lbs"].append(s5)
+    s5.start()
+    assert s5.lanes is None  # lanes_capable=False wins over lanes_n
+
+
+def test_lane_accepts_fund_retry_budget(stack):
+    """Lane accepts sync into the RetryBudget denominator (per poll
+    tick): a connect-fail burst bigger than the burst floor still fails
+    over because the lane traffic itself funded the budget."""
+    lb, ups, g, srv, elg = _mk(stack, "lb-budget")
+    for _ in range(30):  # all lane-served: never touch _on_accept
+        assert tcp_get_id(lb.bind_port) == "A"
+    assert lb.accepted == 0
+    # the lane-0 poll tick (<=1s) credits the budget with those accepts
+    assert _wait(lambda: lb._retry_budget._accepts
+                 + lb._retry_budget._p_accepts >= 30, timeout=3.0)
+
+
+def test_lane_armed_failpoint_forces_classic(stack):
+    """Any armed fault outside lane.* flips punt_all: connections take
+    the python path (failpoint sites keep exact semantics); disarming
+    re-enables the lanes."""
+    lb, ups, g, srv, elg = _mk(stack, "lb-fp")
+    assert tcp_get_id(lb.bind_port) == "A"
+    assert lb.accepted == 0
+    failpoint.arm("backend.connect.refuse", match="never-matches-any")
+    assert tcp_get_id(lb.bind_port) == "A"  # served via python accept
+    assert lb.accepted == 1
+    served_before = lb.lanes.stat()["served"]
+    failpoint.clear()
+    assert _wait(lambda: (tcp_get_id(lb.bind_port) == "A"
+                          and lb.lanes.stat()["served"] > served_before))
+    assert lb.accepted == 1  # python path not used again
+
+
+def test_lane_drain_and_stop(stack):
+    """begin_drain closes lane listeners (new conns refused while live
+    sessions finish); stop() tears the lanes down cleanly and a fresh
+    LB can rebind the port."""
+    lb, ups, g, srv, elg = _mk(stack, "lb-ldrain")
+    port = lb.bind_port
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    assert c.recv(1) == b"A"
+    assert _wait(lambda: lb.lane_active() >= 1)
+    lb.begin_drain()
+    # lanes close their listeners at the next tick
+    def refused():
+        try:
+            c2 = socket.create_connection(("127.0.0.1", port), timeout=1)
+            c2.close()
+            return False
+        except OSError:
+            return True
+    assert _wait(refused)
+    # the in-flight lane session still moves bytes
+    c.sendall(b"still-here")
+    assert c.recv(64) == b"still-here"
+    c.close()
+    assert _wait(lambda: lb.lane_active() == 0)
+    lb.stop()
+    lb2 = TcpLB("lb-ldrain2", lb.acceptor, lb.worker, "127.0.0.1", port,
+                ups, protocol="tcp", lanes=2)
+    stack["lbs"].append(lb2)
+    lb2.start()
+    assert tcp_get_id(port) == "A"

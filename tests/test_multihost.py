@@ -125,10 +125,19 @@ print(f"DIST_OK pid={pid} parity on {B_local} local queries", flush=True)
 
 
 @pytest.mark.timeout(180)
+@pytest.mark.skipif(
+    not M.cpu_collectives_available(),
+    reason="jaxlib lacks multiprocess CPU collectives (gloo): the "
+           "cross-process gather/argmin in the sharded classify cannot "
+           "run on this CPU backend")
 def test_real_two_process_distributed(tmp_path):
     """Spawns two coordinator-connected jax processes; each runs the
     sharded fp classify over the cross-process host mesh with its own
-    local query slice and checks oracle parity."""
+    local query slice and checks oracle parity. Collection-time
+    capability probe: environments whose jaxlib cannot run multiprocess
+    CPU collectives skip instead of failing (init_distributed enables
+    the gloo implementation where it exists, which makes this pass on
+    jaxlib >= 0.4.3x CPU-only containers)."""
     import socket
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

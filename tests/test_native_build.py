@@ -24,11 +24,20 @@ REQUIRED_SYMBOLS = (
     # event loop + sockets + pump (the pre-existing surface)
     "vtl_new", "vtl_poll", "vtl_free", "vtl_pump_new", "vtl_pump_connect",
     "vtl_pump_counters", "vtl_recvmmsg", "vtl_sendmmsg",
-    # switch flow cache (this PR's surface)
+    # switch flow cache (PR-5 surface)
     "vtl_flowcache_new", "vtl_flowcache_free", "vtl_switch_gen_bump",
     "vtl_switch_gen", "vtl_switch_poll", "vtl_flow_install",
     "vtl_flowcache_counters", "vtl_flowcache_stat", "vtl_flow_rec_size",
     "vtl_wait_readable",
+    # accept lanes (this PR's surface) + the io_uring probe
+    "vtl_lanes_new", "vtl_lanes_free", "vtl_lanes_close_listeners",
+    "vtl_lanes_shutdown", "vtl_lanes_port", "vtl_lanes_engine",
+    "vtl_lanes_set_punt_all", "vtl_lanes_set_limit",
+    "vtl_lanes_set_timeout", "vtl_lanes_stat", "vtl_lanes_active",
+    "vtl_lanes_errno",
+    "vtl_lane_counters", "vtl_lane_gen", "vtl_lane_gen_bump",
+    "vtl_lane_install", "vtl_lane_poll", "vtl_lane_rec_size",
+    "vtl_lane_punt_size", "vtl_uring_probe",
 )
 
 
@@ -50,3 +59,46 @@ def test_native_so_rebuilds_and_exports_current_abi():
     assert int(lib.vtl_flow_rec_size()) == vtl.FLOW_REC.size, \
         "C FlowRec layout drifted from net/vtl.py FLOW_REC"
     assert len(vtl.flowcache_counters()) == 5 + len(vtl.FLOW_DROP_REASONS)
+    # lane install/punt records: the C structs and the python packing
+    # must agree bit for bit (the flow-cache ABI guard, lane edition)
+    assert int(lib.vtl_lane_rec_size()) == vtl.LANE_REC.size, \
+        "C LaneRec layout drifted from net/vtl.py LANE_REC"
+    assert int(lib.vtl_lane_punt_size()) == vtl.LANE_PUNT.size, \
+        "C LanePunt layout drifted from net/vtl.py LANE_PUNT"
+    assert len(vtl.lane_counters()) == 5
+
+
+def test_uring_probe_contract():
+    """The io_uring probe is a stable bitmask (bit0 setup, bits 1-5
+    opcodes), cached, and never a precondition: lanes must come up on
+    the epoll engine when the kernel denies io_uring (this container's
+    4.4 kernel returns 0)."""
+    from vproxy_tpu.net import vtl
+    if not vtl.lanes_supported():
+        pytest.skip("no lane symbols in the loaded provider")
+    m = vtl.uring_probe()
+    assert 0 <= m < 64
+    assert m == vtl.uring_probe()  # cached, stable
+    f = vtl.uring_probe_fields()
+    assert set(f) == {"setup", "accept", "connect", "poll", "splice",
+                      "send_zc"}
+    if not f["setup"]:  # opcode bits require a working setup
+        assert m == 0
+
+
+def test_both_engine_paths_compile():
+    """A kernel (or header set) without io_uring must still build and
+    test the epoll lanes: the engine ABI is self-defined in vtl.cpp and
+    -DVTL_NO_URING compiles the ring engine out entirely. Both
+    configurations must at least pass the compiler."""
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain")
+    src = os.path.join(NATIVE_DIR, "vtl.cpp")
+    for flags in ([], ["-DVTL_NO_URING"]):
+        r = subprocess.run(
+            ["g++", "-O0", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+             "-fsyntax-only", *flags, src],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, \
+            f"engine path {flags or ['default']} failed to compile: " \
+            f"{r.stderr[:800]}"

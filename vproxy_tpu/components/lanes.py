@@ -1,0 +1,438 @@
+"""AcceptLanes — Python as the lane-entry COMPILER for TcpLB's C accept
+plane (native/vtl.cpp "accept lanes").
+
+The PR-5 flow-cache division of labor applied to TCP accept: N lane
+threads park inside `vtl_lane_poll` (ctypes releases the GIL) while C
+runs the whole short-connection lifetime — accept4 batch, route lookup
+against the installed lane entry, backend connect, splice, close. This
+module owns everything that must stay in Python:
+
+* **compile + install** — flatten the Upstream's (group weight x server
+  weight) healthy-backend set into LANE_REC records plus the
+  subtract-sum WRR sequence, stamped with the generation read BEFORE
+  the compile began (`vtl_lane_install` rejects a raced stamp with
+  -EAGAIN and we recompile against current state);
+* **generation hooks** — every upstream mutation (Upstream listeners),
+  ACL edit (SecurityGroup listeners) and backend membership/health
+  change (ServerGroup.on_change) bumps the one C atomic
+  (`vtl_lane_gen_bump`) and schedules a recompile. A lane entry whose
+  stamp mismatches is a forced punt: zero stale routing by
+  construction. The `lane.entry.stale` failpoint suppresses exactly one
+  bump (tests/test_lanes.py proves the gate is what prevents stale
+  forwards);
+* **failpoint discipline** — any armed fault outside the lane.* sites
+  flips the C punt_all flag, forcing the classic path so the
+  backend.connect.* / pump.abort injection sites keep exact semantics
+  (the PR-3 `_fast_splice` rule, enforced once per arm edge instead of
+  per accept);
+* **punt dispatch** — classic punts land in `TcpLB._on_accept` on a
+  worker loop (ACL, overload shed, drain shed, accounting all apply);
+  connect-failure punts resolve the backend handle and feed
+  `report_failure` + the bounded retry machinery with the client fd
+  intact, exactly like `vtl_pump_connect`'s connect_failed DONE.
+
+Knobs: VPROXY_TPU_ACCEPT_LANES (lane thread count, 0 = off, the
+default), VPROXY_TPU_ACCEPT_LANES_URING (allow the io_uring engine when
+the runtime probe passes; the epoll engine is the fallback and the only
+engine on pre-5.1 kernels like this container's).
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Optional
+
+from ..net import vtl
+from ..rules.ir import Proto
+from ..utils import events, failpoint
+from ..utils.ip import parse_ip
+from ..utils.log import Logger
+from .servergroup import Connector
+
+_log = Logger("accept-lanes")
+
+LANES = int(os.environ.get("VPROXY_TPU_ACCEPT_LANES", "0"))
+LANES_URING = os.environ.get("VPROXY_TPU_ACCEPT_LANES_URING", "1") != "0"
+_SEQ_CAP = 4096  # WRR sequence bound (weights renormalized past it)
+
+
+def _wrr_seq(weights: list) -> list:
+    """The reference's subtract-sum sequence over backend indexes
+    (ServerGroup._wrr_compute semantics), gcd-reduced and capped so a
+    pathological weight set cannot inflate the C-side table. Equal
+    weights (the common fleet) short-circuit to plain round-robin —
+    the subtract-sum loop is O(picks x n) and the compiler runs on
+    every health edge, so big fleets must not pay it."""
+    if not weights:
+        return []
+    if len(set(weights)) == 1:
+        return list(range(len(weights)))
+    g = 0
+    for w in weights:
+        g = math.gcd(g, w)
+    if g > 1:
+        weights = [w // g for w in weights]
+    total = sum(weights)
+    if total > _SEQ_CAP:
+        weights = [max(1, (w * _SEQ_CAP) // total) for w in weights]
+        total = sum(weights)
+    if total > _SEQ_CAP:
+        # the max(1,..) floor can't shrink below one slot per backend:
+        # a fleet larger than the cap degrades to fair round-robin
+        # (O(n) compile, every backend picked) instead of an O(n*total)
+        # subtract-sum that would pin the compiler on each health edge
+        return list(range(len(weights)))
+    cur = list(weights)
+    seq: list = []
+    while True:
+        idx = max(range(len(cur)), key=lambda i: (cur[i], -i))
+        seq.append(idx)
+        cur[idx] -= total
+        if all(w == 0 for w in cur):
+            return seq
+        for i in range(len(cur)):
+            cur[i] += weights[i]
+
+
+class AcceptLanes:
+    """One per lanes-enabled TcpLB; owns the C handle, the lane threads
+    and every registered mutation hook."""
+
+    def __init__(self, lb, n: int, uring: bool = LANES_URING):
+        self.lb = lb
+        self.n = n
+        self.uring = uring
+        self.handle = 0
+        self.threads: list[threading.Thread] = []
+        self._compiler: Optional[threading.Thread] = None
+        self._dirty = threading.Event()
+        self._stop = False
+        self._groups: set = set()  # groups holding our on_change hook
+        self._hook_lock = threading.Lock()
+        # serializes vtl_lanes_free against cross-thread stat()/active()
+        # readers (list-detail, HTTP detail, drain polling): the C
+        # object must not be freed mid-read
+        self._handle_lock = threading.Lock()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """Bind the lane listeners (resolving an ephemeral bind_port),
+        install the first lane entry, register every generation hook and
+        launch the lane + compiler threads. Raises OSError on bind
+        failure — the caller falls back to the python accept path."""
+        lb = self.lb
+        self.handle = vtl.lanes_new(
+            lb.bind_ip, lb.bind_port, 512, self.n, lb.in_buffer_size,
+            self.uring, lb.timeout_ms, lb.connect_timeout_ms)
+        if lb.bind_port == 0:
+            lb.bind_port = vtl.lanes_port(self.handle)
+        vtl.lanes_set_limit(self.handle, lb.max_sessions)
+        lb.backend.add_listener(self._on_mutation)
+        lb.security_group.add_listener(self._on_mutation)
+        failpoint.on_change.append(self._on_failpoints)
+        self._on_failpoints()  # pick up faults armed before start
+        self._compile_install()
+        self._compiler = threading.Thread(
+            target=self._compile_loop, name=f"lane-compile-{lb.alias}",
+            daemon=True)
+        self._compiler.start()
+        for i in range(self.n):
+            t = threading.Thread(target=self._lane_loop, args=(i,),
+                                 name=f"lane-{lb.alias}-{i}", daemon=True)
+            t.start()
+            self.threads.append(t)
+        events.record(
+            "lanes", f"lb {lb.alias}: {self.n} accept lanes on "
+            f"{lb.bind_ip}:{lb.bind_port} engine={self.engine()}",
+            lb=lb.alias, lanes=self.n, engine=self.engine())
+
+    def close_listeners(self) -> None:
+        """Drain: lanes stop accepting (each lane closes its own
+        listener at the next tick); live spliced sessions run on."""
+        if self.handle:
+            vtl.lanes_close_listeners(self.handle)
+
+    def shutdown(self) -> None:
+        """Stop: close listeners, give in-flight pumps a short grace,
+        then tear down threads, hooks and the native object."""
+        lb = self.lb
+        self._stop = True
+        self._dirty.set()
+        if self.handle:
+            vtl.lanes_shutdown(self.handle, 500)
+        for t in self.threads:
+            t.join(3)
+        if self._compiler is not None:
+            self._compiler.join(3)
+        lb.backend.remove_listener(self._on_mutation)
+        lb.security_group.remove_listener(self._on_mutation)
+        try:
+            failpoint.on_change.remove(self._on_failpoints)
+        except ValueError:
+            pass
+        with self._hook_lock:
+            groups, self._groups = self._groups, set()
+        for g in groups:
+            g.off_change(self._on_mutation)
+        alive = [t for t in self.threads if t.is_alive()]
+        if self._compiler is not None and self._compiler.is_alive():
+            alive.append(self._compiler)  # mid-compile: it holds handle
+        if alive:
+            # a wedged lane/compiler thread still owns the native
+            # object: freeing under it would be a use-after-free — leak
+            # instead. self.handle stays nonzero ON PURPOSE: the wedged
+            # thread keeps using its live (leaked) object, never NULL.
+            _log.alert(f"lanes {lb.alias}: {len(alive)} thread(s) did "
+                       "not exit; leaking native lanes")
+            return
+        with self._handle_lock:  # no stat()/active() mid-free
+            h, self.handle = self.handle, 0
+        vtl.lanes_free(h)
+
+    # ------------------------------------------------------------ state
+
+    def engine(self) -> str:
+        with self._handle_lock:  # like every cross-thread reader
+            return vtl.lanes_engine(self.handle) if self.handle else "off"
+
+    def stat(self) -> dict:
+        """list-detail / HTTP detail payload. Reads under the handle
+        lock so a concurrent shutdown cannot free the C object mid-
+        read."""
+        with self._handle_lock:
+            if not self.handle:
+                return {"on": False}
+            (accepted, served, active, p_classic, p_stale, p_fail,
+             nbytes, gen, engine, port, killed) = vtl.lanes_stat(
+                 self.handle)
+        punts = p_classic + p_stale + p_fail
+        return {"on": True, "lanes": self.n,
+                "engine": "uring" if engine else "epoll",
+                "uring_probe": vtl.uring_probe_fields(),
+                "gen": gen, "accepted": accepted, "served": served,
+                "active": active, "punts": punts,
+                "punt_stale": p_stale, "punt_connect_fail": p_fail,
+                "killed": killed, "bytes": nbytes,
+                "hit_rate": round(
+                    (served + killed) / max(1, served + killed + punts),
+                    4),
+                "port": port}
+
+    def active(self) -> int:
+        """Live lane-owned sessions (drain accounting + the per-accept
+        overload check): one atomic load under the handle lock."""
+        with self._handle_lock:
+            if not self.handle:
+                return 0
+            return vtl.lanes_active(self.handle)
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        """Hot-set the lane idle timeout — under the handle lock (a
+        hot-update racing remove/stop must not reach a freed Lanes*)."""
+        with self._handle_lock:
+            if self.handle:
+                vtl.lanes_set_timeout(self.handle, timeout_ms)
+
+    def set_limit(self, n: int) -> None:
+        """Hot-set the lane active-session bound (same locking)."""
+        with self._handle_lock:
+            if self.handle:
+                vtl.lanes_set_limit(self.handle, n)
+
+    # ------------------------------------------------------------ hooks
+
+    def _on_mutation(self) -> None:
+        """ANY routing-relevant mutation lands here (upstream recalc,
+        ACL edit, group membership/health edge). Bump first — the gate
+        must close before the new state is even readable — then defer
+        the recompile to the compiler thread (callers may hold group
+        locks; the compile takes none but must not run under them)."""
+        if failpoint.hit("lane.entry.stale", self.lb.alias):
+            # suppress exactly ONE bump: the stale lane entry stays
+            # serveable, proving the generation gate (not timing) is
+            # what prevents stale routing — tests/test_lanes.py
+            return
+        if self.handle:
+            vtl.lane_gen_bump(self.handle)
+        self._dirty.set()
+
+    def _on_failpoints(self) -> None:
+        """Armed faults (outside lane.*) force every accept down the
+        classic path so injection-site semantics stay exact."""
+        if self.handle:
+            vtl.lanes_set_punt_all(
+                self.handle, failpoint.any_armed_excluding("lane."))
+
+    # ------------------------------------------------------------ compile
+
+    def _compile_loop(self) -> None:
+        while not self._stop:
+            self._dirty.wait(timeout=1.0)
+            if self._stop:
+                return
+            if not self._dirty.is_set():
+                continue
+            self._dirty.clear()
+            try:
+                self._compile_install()
+            except Exception as e:  # never kill the compiler thread
+                _log.alert(f"lanes {self.lb.alias}: compile failed: {e!r}")
+
+    def _compile_install(self) -> None:
+        """Snapshot -> LANE_RECs + WRR seq -> vtl_lane_install, retried
+        while mutations race the compile (bounded; the gate keeps
+        correctness either way — worst case the entry stays empty and
+        every accept punts)."""
+        lb = self.lb
+        for _ in range(8):
+            gen = vtl.lane_gen(self.handle)
+            recs, seq = self._compile()
+            r = vtl.lane_install(self.handle, b"".join(recs), len(recs),
+                                 seq, gen)
+            if r >= 0:
+                return
+            # -EAGAIN: a bump landed mid-compile; go again vs new state
+        _log.warn(f"lanes {lb.alias}: install kept racing mutations; "
+                  "entry left stale-gated (all accepts punt)")
+
+    def _compile(self):
+        """Flatten the upstream into (backend, combined-weight) records.
+        Non-trivial ACLs and TLS holders compile to an EMPTY entry —
+        every accept punts to the python path that owns those checks.
+        Also (re)subscribes group change hooks for the current group
+        set."""
+        lb = self.lb
+        handles = list(lb.backend.handles)
+        groups = {gh.group for gh in handles}
+        with self._hook_lock:
+            for g in groups - self._groups:
+                g.on_change(self._on_mutation)
+            for g in self._groups - groups:
+                g.off_change(self._on_mutation)
+            self._groups = groups
+        if (lb.holder is not None or lb.draining
+                or not lb.security_group.trivial_allow(Proto.TCP)):
+            return [], []
+        # non-wrr balancing (source affinity, wlc least-connections)
+        # cannot be expressed as a static pick sequence: compile EMPTY —
+        # every accept punts and the python path keeps the configured
+        # semantics (the same rule as non-trivial ACLs)
+        if any(gh.group.method != "wrr" for gh in handles):
+            return [], []
+        # two-level pick, exactly like the classic path (group-level
+        # WRR, then THAT group's own server WRR): flattening
+        # gh.weight*s.weight would skew multi-group proportions by
+        # server count. Emit the outer group sequence with each slot
+        # resolved through the group's rotating server sequence.
+        recs, group_seqs = [], []
+        for gh in handles:
+            if gh.weight <= 0:
+                continue
+            sidx, sweights = [], []
+            for s in list(gh.group.servers):
+                if not s.healthy or s.logic_delete or s.weight <= 0:
+                    continue
+                sidx.append(len(recs))
+                sweights.append(s.weight)
+                recs.append(vtl.LANE_REC.pack(
+                    s.ip.encode(), s.port, 1 if ":" in s.ip else 0,
+                    min(255, s.weight)))
+            if sidx:
+                group_seqs.append(
+                    (gh.weight, [sidx[i] for i in _wrr_seq(sweights)]))
+        if not group_seqs:
+            return recs, []
+        outer = _wrr_seq([w for w, _ in group_seqs])
+        # close EVERY group's rotation: lcm of the inner sequence
+        # lengths (max alone leaves shorter rotations mid-cycle at the
+        # wrap point — a persistent intra-group weight skew). The cap
+        # bounds pathological lcm blowups; a capped sequence wraps with
+        # at most one inner-cycle misalignment per seqlen picks.
+        reps = 1
+        for _, sq in group_seqs:
+            reps = math.lcm(reps, len(sq))
+        reps = min(reps, max(1, _SEQ_CAP // max(1, len(outer))))
+        order, cursors = [], [0] * len(group_seqs)
+        for _ in range(reps):
+            for gi in outer:
+                sq = group_seqs[gi][1]
+                order.append(sq[cursors[gi] % len(sq)])
+                cursors[gi] += 1
+        return recs, order
+
+    # ------------------------------------------------------------ punts
+
+    def _lane_loop(self, idx: int) -> None:
+        # snapshot the handle: shutdown() zeroes self.handle after the
+        # join window, and a late (wedged-then-recovered) thread must
+        # keep polling the real — possibly leaked — C object, never 0
+        handle = self.handle
+        last_accepted = 0
+        while True:
+            try:
+                punts = vtl.lane_poll(handle, idx, 1000)
+            except OSError as e:
+                _log.alert(f"lane {self.lb.alias}/{idx} poll: {e!r}")
+                return
+            if idx == 0:
+                # retry-budget denominator: lane-SERVED accepts never
+                # pass through _on_accept, but their connect-fail punts
+                # SPEND the budget — credit them in batches (per poll
+                # tick, lane 0 only). Classic/stale punts are excluded:
+                # those land in _on_accept, which credits them itself
+                # (double-crediting would double the retry allowance
+                # exactly in degraded punt-heavy states).
+                try:
+                    st = vtl.lanes_stat(handle)
+                    acc = st[0] - st[3] - st[4]  # - classic - stale
+                except OSError:
+                    acc = last_accepted
+                if acc > last_accepted:
+                    self.lb._retry_budget.on_accepts(acc - last_accepted)
+                    last_accepted = acc
+            if punts is None:
+                return  # lanes_shutdown drained this lane
+            for p in punts:
+                try:
+                    self._dispatch(p)
+                except Exception:
+                    vtl.close(p[0])
+
+    def _dispatch(self, punt) -> None:
+        fd, kind, err, cip, cport, bip, bport = punt
+        lb = self.lb
+        try:
+            wl = lb.worker.next()
+        except Exception:
+            vtl.close(fd)
+            return
+        if kind == vtl.LANE_PUNT_CONNECT_FAIL:
+            target = self._find_backend(bip, bport)
+            if target is not None:
+                src = parse_ip(cip) if cip else b""
+
+                def run(wl=wl, target=target):
+                    # same ownership contract as a python connect
+                    # failure: report_failure feeds the ejection streak
+                    # and the bounded retry either re-dials or closes
+                    lb._backend_connect_failed(
+                        wl, fd, target, b"", f"{cip}:{cport}", None, src,
+                        0, set(), err, hint=None)
+
+                if not wl.run_on_loop(run):
+                    vtl.close(fd)
+                return
+            # backend vanished from the tables since the entry compiled:
+            # fall through — the classic path re-decides from scratch
+        if not wl.run_on_loop(
+                lambda: lb._on_accept(wl, fd, cip, cport)):
+            vtl.close(fd)
+
+    def _find_backend(self, ip: str, port: int) -> Optional[Connector]:
+        for gh in list(self.lb.backend.handles):
+            for s in list(gh.group.servers):
+                if s.ip == ip and s.port == port and not s.logic_delete:
+                    return Connector(s, gh.group)
+        return None

@@ -106,6 +106,13 @@ class SecurityGroup:
                         acl=sub, payload=sub)
         self._tables[proto] = (m, sub)  # atomic publish
 
+    def trivial_allow(self, proto: Proto) -> bool:
+        """True when allow() can only ever answer True for `proto` (no
+        rules for it + default allow) — the accept lanes serve in C only
+        under a trivially-allowing group; anything else punts every
+        connection to the python ACL path."""
+        return self.default_allow and self._tables.get(proto) is None
+
     def allow(self, proto: Proto, addr: bytes, port: int) -> bool:
         ent = self._tables.get(proto)
         if ent is None:

@@ -301,6 +301,13 @@ class ServerGroup:
         self.servers: list[ServerHandle] = []
         self._checkers: dict[str, _HealthChecker] = {}
         self._listeners: list[Callable[[ServerHandle, bool], None]] = []
+        # generic change listeners: fired on EVERY health edge AND every
+        # membership/weight recalc (the superset of on_health_change).
+        # The accept lanes subscribe their generation bump here so any
+        # mutation of the routable set invalidates the C lane entry.
+        # Callbacks may run under the group lock (recalc paths) and must
+        # not take group locks themselves — bump-and-defer only.
+        self._change_listeners: list = []
         # bumped on every health edge and membership/weight recalc: a
         # cheap staleness token for answer caches (dns/server.py) that
         # must never serve a backend past its DOWN edge
@@ -386,6 +393,22 @@ class ServerGroup:
                     return
         raise KeyError(name)
 
+    def on_change(self, cb: Callable[[], None]) -> None:
+        self._change_listeners.append(cb)
+
+    def off_change(self, cb: Callable[[], None]) -> None:
+        try:
+            self._change_listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def _fire_change(self) -> None:
+        for cb in list(self._change_listeners):
+            try:
+                cb()
+            except Exception:
+                pass
+
     def on_health_change(self, cb: Callable[[ServerHandle, bool], None]) -> None:
         self._listeners.append(cb)
 
@@ -406,6 +429,7 @@ class ServerGroup:
                       group=self.alias, server=svr.name)
         for cb in self._listeners:
             cb(svr, up)
+        self._fire_change()
 
     # ---------------------------------------- passive outlier ejection
 
@@ -496,6 +520,7 @@ class ServerGroup:
     def _recalc(self) -> None:
         self.health_version += 1  # membership/weight change
         self._wrr_cache.clear()
+        self._fire_change()  # lane-entry invalidation (bump-and-defer)
 
     @staticmethod
     def _wrr_compute(servers: list[ServerHandle]) -> list[int]:

@@ -34,6 +34,10 @@ class Socks5Server(TcpLB):
     """Same resource shape as TcpLB (bind, elgroups, upstream, secgroup)
     with the SOCKS5 handshake instead of http/tcp classify."""
 
+    # protocol reads "tcp" but every client speaks RFC 1928 first: the
+    # C accept lanes must never raw-splice a SOCKS5 connection
+    lanes_capable = False
+
     def __init__(self, alias: str, acceptor: EventLoopGroup,
                  worker: EventLoopGroup, bind_ip: str, bind_port: int,
                  backend: Upstream,

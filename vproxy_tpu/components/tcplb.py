@@ -32,6 +32,7 @@ from ..utils.log import Logger
 from ..utils.metrics import accept_stage_observe
 from .elgroup import EventLoopGroup
 from .l7 import L7Engine
+from .lanes import LANES, AcceptLanes
 from .pool import ConnectionPool, PoolHandler
 from .secgroup import SecurityGroup
 from .servergroup import Connector
@@ -94,6 +95,16 @@ class RetryBudget:
         with self._lock:
             self._roll(time.monotonic())
             self._accepts += 1
+
+    def on_accepts(self, n: int) -> None:
+        """Bulk credit — the C accept lanes sync their accepted counter
+        in batches (per lane-poll tick): lane traffic must fund the
+        budget its own connect-fail punts spend."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._roll(time.monotonic())
+            self._accepts += n
 
     def try_take(self) -> bool:
         """Reserve one retry; False when the budget is exhausted."""
@@ -269,7 +280,8 @@ class TcpLB:
                  security_group: Optional[SecurityGroup] = None,
                  in_buffer_size: int = 65536, timeout_ms: int = 900_000,
                  cert_keys: Optional[list] = None,
-                 max_sessions: int = 0, pool_size: int = -1):
+                 max_sessions: int = 0, pool_size: int = -1,
+                 lanes: int = -1):
         if protocol not in ("tcp", "http-splice") \
                 and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
@@ -306,6 +318,11 @@ class TcpLB:
         # pre-connected idle sockets, lazily spawned on first use,
         # drained on backend DOWN edges (hc or passive ejection)
         self.pool_size = POOL_SIZE if pool_size < 0 else pool_size
+        # C accept lanes (docs/perf.md): when eligible, N native lane
+        # threads own every listener and run short connections without
+        # touching Python; self.lanes is the AcceptLanes manager or None
+        self.lanes_n = LANES if lanes < 0 else lanes
+        self.lanes: Optional[AcceptLanes] = None
         self._pools: dict[tuple, ConnectionPool] = {}
         self._pool_lock = threading.Lock()
         self._pool_groups: set = set()   # groups with our health listener
@@ -356,11 +373,35 @@ class TcpLB:
         except OSError as e:
             _log.alert(f"tcp-lb {self.alias}: re-home bind failed: {e!r}")
 
+    # subclasses that wrap the byte stream in their own handshake
+    # (Socks5Server passes protocol="tcp" but speaks RFC 1928 first)
+    # MUST NOT let the C lanes raw-splice their clients
+    lanes_capable = True
+
+    def _lanes_eligible(self) -> bool:
+        return (self.lanes_capable and self.lanes_n > 0
+                and self.protocol == "tcp"
+                and self.holder is None and vtl.lanes_supported()
+                and bool(self.worker.loops))
+
     def start(self) -> None:
         if self.started:
             return
         self.started = True
         self.acceptor.attach(self)
+        # C accept lanes: when eligible they own ALL the listeners (the
+        # whole point is the accept edge never entering Python); punts
+        # reach the classic path through the lane threads, so no python
+        # listener is needed. Bind failure falls back to python accepts.
+        if self._lanes_eligible():
+            try:
+                lanes = AcceptLanes(self, self.lanes_n)
+                lanes.start()  # resolves bind_port when 0
+                self.lanes = lanes
+                return
+            except OSError as e:
+                _log.warn(f"tcp-lb {self.alias}: accept lanes failed "
+                          f"({e}); falling back to python accepts")
         loops = self.acceptor.loops
         # bind loops one at a time so an ephemeral port (bind_port=0) is
         # resolved once and the remaining loops share it via REUSEPORT
@@ -387,6 +428,9 @@ class TcpLB:
             return
         self.started = False
         self.acceptor.detach(self)
+        if self.lanes is not None:
+            self.lanes.shutdown()
+            self.lanes = None
         for ss in self.server_socks:
             ss.loop.run_on_loop(ss.close)
         self.server_socks = []
@@ -409,6 +453,9 @@ class TcpLB:
                       f"{self.active_sessions} sessions in flight",
                       lb=self.alias, sessions=self.active_sessions)
         if self.started:
+            if self.lanes is not None:
+                # lanes stop accepting; live lane pumps run to completion
+                self.lanes.close_listeners()
             for ss in self.server_socks:
                 ss.loop.run_on_loop(ss.close)
             self.server_socks = []
@@ -421,6 +468,13 @@ class TcpLB:
     def _sessions_delta(self, d: int) -> None:
         with self._sess_lock:
             self.active_sessions += d
+            n = self.active_sessions
+        lanes = self.lanes
+        if lanes is not None:
+            # the overload ceiling is SHARED: the C lanes admit only the
+            # remaining budget, so python-held sessions (punts) can
+            # never stack a second max_sessions on top of the lane ones
+            lanes.set_limit(max(0, self.max_sessions - n))
 
     def _retries_total(self, result: str):
         c = self._retry_ctrs.get(result)
@@ -668,8 +722,11 @@ class TcpLB:
             events.record("drain_shed", f"{ip}:{port} shed: draining",
                           lb=self.alias)
             return
-        if self.active_sessions >= self.max_sessions:
-            # overload guard: close-on-accept beats queueing unboundedly
+        if self.active_sessions + self.lane_active() >= self.max_sessions:
+            # overload guard: close-on-accept beats queueing unboundedly.
+            # Lane-owned sessions count against the same budget — the C
+            # side bounds itself at max_sessions and punts past it, and
+            # this check stops those punts from doubling the ceiling.
             self._overload_total().incr()
             vtl.close(cfd)
             events.record(
@@ -914,11 +971,56 @@ class TcpLB:
         holder = CertKeyHolder(cert_keys, alpn=alpn)  # may raise: no change
         self.cert_keys = cert_keys
         self.holder = holder
+        if getattr(self, "lanes", None) is not None:  # ctor calls this
+            # lanes route plaintext in C — they cannot terminate TLS.
+            # A hot cert install on a running lanes LB tears the lanes
+            # down and rebinds python listeners on the same port.
+            _log.warn(f"tcp-lb {self.alias}: TLS certs installed; "
+                      "disabling C accept lanes")
+            lanes, self.lanes = self.lanes, None
+            lanes.shutdown()
+            if self.started:
+                for lp in self.acceptor.loops:
+                    def mk(lp=lp) -> None:
+                        self.server_socks.append(ServerSock(
+                            lp, self.bind_ip, self.bind_port,
+                            lambda fd, ip, port, lp=lp: self._on_accept(
+                                lp, fd, ip, port),
+                            reuseport=len(self.acceptor.loops) > 1))
+                    lp.call_sync(mk)
+
+    def set_security_group(self, sg: SecurityGroup) -> None:
+        """Hot-swap the ACL group; a lanes LB moves its mutation hook to
+        the new group and recompiles (the old entry is gen-gated out)."""
+        old = self.security_group
+        self.security_group = sg
+        if self.lanes is not None:
+            old.remove_listener(self.lanes._on_mutation)
+            sg.add_listener(self.lanes._on_mutation)
+            self.lanes._on_mutation()
+
+    def lane_active(self) -> int:
+        """Live lane-owned sessions (drain accounting: these are real
+        in-flight client sessions invisible to active_sessions)."""
+        return self.lanes.active() if self.lanes is not None else 0
+
+    def set_max_sessions(self, n: int) -> None:
+        """Hot-set the overload ceiling for BOTH admission paths: the
+        python accept check and the C lanes' active bound."""
+        self.max_sessions = n if n > 0 else MAX_SESSIONS
+        lanes = self.lanes
+        if lanes is not None:
+            lanes.set_limit(max(0, self.max_sessions
+                                - self.active_sessions))
 
     def set_timeout(self, timeout_ms: int) -> None:
         """Hot-set the idle timeout AND re-arm the per-loop idle sweeps:
         an armed sweep waits timeout/4, so lowering the timeout without
-        re-arming would only bite after the OLD interval elapsed."""
+        re-arming would only bite after the OLD interval elapsed. Lane
+        sweeps read the C-side value per pass — forwarded here."""
+        lanes = self.lanes
+        if lanes is not None:
+            lanes.set_timeout(timeout_ms)
         self.timeout_ms = timeout_ms
         for lid, lp in list(self._watch_loops.items()):
             def rearm(lid=lid, lp=lp) -> None:

@@ -47,6 +47,27 @@ class Upstream:
         self._wrr_groups: list[GroupHandle] = []
         self._wrr_cursor = 0
         self._lock = threading.Lock()
+        # mutation listeners, fired AFTER a recalc publishes (lock
+        # released): the accept lanes register their generation bump +
+        # lane-entry recompile here so add/remove/annotation edits
+        # invalidate the C-resident route table immediately
+        self._listeners: list = []
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
+    def _fire(self) -> None:
+        for cb in list(self._listeners):
+            try:
+                cb()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- admin
 
@@ -58,6 +79,7 @@ class Upstream:
             h = GroupHandle(group, weight, annotations)
             self.handles.append(h)
             self._recalc()
+        self._fire()
         return h
 
     def remove(self, group: ServerGroup) -> None:
@@ -66,8 +88,10 @@ class Upstream:
                 if h.group is group:
                     del self.handles[i]
                     self._recalc()
-                    return
-        raise KeyError(group.alias)
+                    break
+            else:
+                raise KeyError(group.alias)
+        self._fire()
 
     def set_annotations(self, group: ServerGroup, annotations: HintRule) -> None:
         with self._lock:
@@ -75,8 +99,10 @@ class Upstream:
                 if h.group is group:
                     h.annotations = annotations
                     self._recalc()
-                    return
-        raise KeyError(group.alias)
+                    break
+            else:
+                raise KeyError(group.alias)
+        self._fire()
 
     def _recalc(self) -> None:
         # the handle list is the rules' payload: published atomically

@@ -92,6 +92,16 @@ class Application:
 
     # ------------------------------------------------------ graceful drain
 
+    def sessions_in_flight(self) -> int:
+        """Live client sessions across every LB surface: python-side
+        active_sessions plus sessions owned by C accept lanes (real
+        in-flight work the drain contract protects, invisible to the
+        python counter)."""
+        return sum(lb.active_sessions
+                   + getattr(lb, "lane_active", lambda: 0)()
+                   for lb in list(self.tcp_lbs.values())
+                   + list(self.socks5_servers.values()))
+
     def request_drain(self) -> str:
         """Begin graceful drain (SIGTERM and the `drain` command funnel
         here): flip /healthz to draining so upstream LBs steer away,
@@ -101,9 +111,7 @@ class Application:
         from ..utils import events, lifecycle
         if not lifecycle.set_draining():
             return "already draining"
-        total = sum(lb.active_sessions
-                    for lb in list(self.tcp_lbs.values())
-                    + list(self.socks5_servers.values()))
+        total = self.sessions_in_flight()
         events.record("drain", f"drain requested: {total} sessions in "
                       "flight, healthz now draining", sessions=total)
         for lb in list(self.tcp_lbs.values()) \
@@ -127,9 +135,7 @@ class Application:
         deadline = _time.monotonic() + timeout_s
         zero_since = None
         while True:
-            left = sum(lb.active_sessions
-                       for lb in list(self.tcp_lbs.values())
-                       + list(self.socks5_servers.values()))
+            left = self.sessions_in_flight()
             now = _time.monotonic()
             if left <= 0:
                 if zero_since is None:

@@ -98,6 +98,7 @@ PARAM_KEYS = {
     "count": "count", "match": "match",
     "max-sessions": "max-sessions",
     "pool-size": "pool-size",
+    "lanes": "lanes",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -579,7 +580,9 @@ def _h_tl(app: Application, c: Command):
                    max_sessions=(_nonneg_int(c, "max-sessions")
                                  if "max-sessions" in c.params else 0),
                    pool_size=(_nonneg_int(c, "pool-size")
-                              if "pool-size" in c.params else -1))
+                              if "pool-size" in c.params else -1),
+                   lanes=(_nonneg_int(c, "lanes")
+                          if "lanes" in c.params else -1))
         lb.start()
         app.tcp_lbs[c.alias] = lb
         return "OK"
@@ -590,14 +593,16 @@ def _h_tl(app: Application, c: Command):
                 f"bind {lb.bind_ip}:{lb.bind_port} backend {lb.backend.alias} "
                 f"in-buffer-size {lb.in_buffer_size} protocol {lb.protocol} "
                 f"security-group {lb.security_group.alias}"
+                + _lane_summary(lb)
                 for lb in app.tcp_lbs.values()]
     if c.action == "update":
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
         if "in-buffer-size" in c.params:
             lb.in_buffer_size = int(c.params["in-buffer-size"])
         if "secg" in c.params:
-            lb.security_group = _need(app.security_groups, c.params["secg"],
-                                      "security-group")
+            lb.set_security_group(_need(app.security_groups,
+                                        c.params["secg"],
+                                        "security-group"))
         # validate/build EVERYTHING before applying anything: a failed
         # command must not leave the LB half-updated
         new_timeout = _pos_int(c, "timeout") if "timeout" in c.params else None
@@ -611,10 +616,9 @@ def _h_tl(app: Application, c: Command):
         if new_timeout is not None:  # hot-settable (TcpLB.java:294-320)
             lb.set_timeout(new_timeout)
         if "max-sessions" in c.params:  # hot-set the overload guard;
-            # 0 restores the default ceiling (same convention as add)
-            from ..components.tcplb import MAX_SESSIONS as _def_ms
-            ms = _nonneg_int(c, "max-sessions")
-            lb.max_sessions = ms if ms > 0 else _def_ms
+            # 0 restores the default ceiling (same convention as add).
+            # set_max_sessions also forwards the bound to the C lanes.
+            lb.set_max_sessions(_nonneg_int(c, "max-sessions"))
         if "pool-size" in c.params:  # hot-set the warm backend pool
             # (0 = off); existing pools drain and respawn at the new size
             lb.set_pool_size(_nonneg_int(c, "pool-size"))
@@ -625,6 +629,20 @@ def _h_tl(app: Application, c: Command):
         del app.tcp_lbs[c.alias]
         return "OK"
     raise CmdError(f"unsupported action {c.action} for tcp-lb")
+
+
+def _lane_summary(lb) -> str:
+    """`list-detail tcp-lb` lane column: off, or
+    on(n,engine=uring|epoll,gen,served,punts,hit-rate)."""
+    lanes = lb.lanes  # local: a concurrent stop() may None the attr
+    if lanes is None:
+        return " lanes off"
+    st = lanes.stat()  # stat() itself locks against lanes_free
+    if not st.get("on"):
+        return " lanes off"
+    return (f" lanes on(n={st['lanes']},engine={st['engine']},"
+            f"gen={st['gen']},served={st['served']},punts={st['punts']},"
+            f"hit-rate={st['hit_rate']})")
 
 
 def _h_socks5(app: Application, c: Command):

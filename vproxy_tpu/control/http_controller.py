@@ -52,6 +52,8 @@ def _details(app, rtype: str) -> list:
             "inBufferSize": lb.in_buffer_size, "timeout": lb.timeout_ms,
             "activeSessions": getattr(lb, "active_sessions", 0),
             "listOfCertKey": [ck.alias for ck in lb.cert_keys],
+            "lanes": (lambda _l: _l.stat() if _l is not None
+                      else {"on": False})(lb.lanes),
         } for a, lb in app.tcp_lbs.items()]
     if rtype == "socks5-server":
         return [{

@@ -23,13 +23,18 @@
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <sys/un.h>
 #include <time.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <deque>
+#include <memory>
+#include <mutex>
 #include <unordered_map>
 #include <unordered_set>
 #include <algorithm>
@@ -346,13 +351,24 @@ int vtl_sock_name(int fd, int peer, char* ipbuf, int ipbuflen, int* port) {
 // ---------------------------------------------------------------- loop
 
 struct Pump;
+struct Lane;
 
 struct Handler {
-  enum Kind { PY = 0, WAKE = 1, PUMP_A = 2, PUMP_B = 3 } kind;
+  enum Kind { PY = 0, WAKE = 1, PUMP_A = 2, PUMP_B = 3, LANE = 4 } kind;
   uint64_t tag;   // PY: python tag; PUMP_*: owning pump id
   Pump* pump;     // PUMP_* only
   int fd;
   uint32_t interest;  // current epoll interest (VTL_EV_*)
+  // --- io_uring engine bookkeeping (accept lanes; zero-cost on epoll)
+  // One oneshot POLL_ADD at a time per fd; pending_ev remembers what it
+  // was armed for so an interest change mid-flight cancels + re-arms.
+  // inflight counts CQEs still owed to this handler — its memory must
+  // not be freed until they have all drained (uring user_data holds the
+  // raw pointer; see lane garbage collection).
+  uint16_t pending_ev = 0;
+  bool poll_pending = false;
+  bool ms_accept = false;   // LANE: multishot accept currently armed
+  int inflight = 0;
 };
 
 struct Ring {
@@ -399,6 +415,8 @@ struct Pump {
       : id(i), fd_a(a), fd_b(b), a2b(cap), b2a(cap) {}
 };
 
+struct Uring;
+
 struct Loop {
   int ep = -1;
   int wakefd = -1;
@@ -411,6 +429,10 @@ struct Loop {
   // the poll loop checks membership here before dereferencing.
   std::unordered_set<Handler*> valid;
   std::vector<Handler*> garbage;
+  // accept lanes may run this loop on the io_uring engine instead of
+  // epoll: readiness is then delivered as batched oneshot-POLL CQEs
+  // through one ring (ur != nullptr) and ep stays unused.
+  Uring* ur = nullptr;
 };
 
 static void drop_handler(Loop* l, Handler* h) {
@@ -425,7 +447,12 @@ static uint32_t to_ep(uint32_t ev) {
   return e;
 }
 
+static int uring_set_interest(Loop* l, Handler* h, uint32_t interest);
+static void uring_detach(Loop* l, Handler* h);
+static void uring_free(Uring* u);
+
 static int ep_set(Loop* l, Handler* h, uint32_t interest) {
+  if (l->ur) return uring_set_interest(l, h, interest);
   epoll_event e;
   memset(&e, 0, sizeof(e));
   e.events = to_ep(interest);
@@ -435,6 +462,24 @@ static int ep_set(Loop* l, Handler* h, uint32_t interest) {
   h->interest = interest;
   return 0;
 }
+
+// unregister an fd's readiness source before it closes: epoll_ctl DEL,
+// or (uring) cancel the outstanding poll so the ring drops its file
+// reference — an fd closed with a live uring poll would never be
+// released by the kernel.
+static void loop_detach(Loop* l, Handler* h) {
+  if (l->ur) {
+    uring_detach(l, h);
+    return;
+  }
+  epoll_ctl(l->ep, EPOLL_CTL_DEL, h->fd, nullptr);
+}
+
+// NOTE: an earlier round skipped the epoll_ctl DEL for fds that close
+// immediately after (Linux auto-removes a closed fd's registration) —
+// REVERTED: under full-suite fd-reuse load this sandbox kernel
+// surfaced stale registrations as EPOLLERR/EIO on live pumps. The DEL
+// stays explicit on both engines.
 
 void* vtl_new() {
   Loop* l = new Loop();
@@ -635,7 +680,7 @@ static void pump_kill(Loop* l, Pump* p, int err) {
   for (int fd : {p->fd_a, p->fd_b}) {
     auto it = l->handlers.find(fd);
     if (it != l->handlers.end()) {
-      epoll_ctl(l->ep, EPOLL_CTL_DEL, fd, nullptr);
+      loop_detach(l, it->second);
       drop_handler(l, it->second);
       l->handlers.erase(it);
     }
@@ -644,9 +689,14 @@ static void pump_kill(Loop* l, Pump* p, int err) {
   l->done_pumps.push_back(p->id);
 }
 
-// move bytes: read src->ring, write ring->dst. returns false on fatal error.
+// move bytes: read src->ring, write ring->dst. returns false on fatal
+// error. peer_done = the opposite direction already hit EOF with its
+// ring drained: the pump dies the moment THIS direction finishes, and
+// the close() carries the FIN — the explicit shutdown would be a
+// wasted syscall per short connection.
 static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
-                      bool& src_eof, bool& dst_shut, uint64_t& counter) {
+                      bool& src_eof, bool& dst_shut, uint64_t& counter,
+                      bool peer_done) {
   // write pending data first
   while (!ring.empty()) {
     size_t chunk = std::min(ring.size, ring.cap() - ring.head);
@@ -695,9 +745,10 @@ static bool pump_flow(Loop* l, Pump* p, int src, int dst, Ring& ring,
       return false;
     }
   }
-  // src closed and everything flushed -> propagate FIN
+  // src closed and everything flushed -> propagate FIN (unless the
+  // whole pump is about to die: close() sends it for free)
   if (src_eof && ring.empty() && !dst_shut) {
-    shutdown(dst, SHUT_WR);
+    if (!peer_done) shutdown(dst, SHUT_WR);
     dst_shut = true;
   }
   return true;
@@ -885,10 +936,10 @@ static void pump_run(Loop* l, Pump* p) {
     return;
   }
   if (!pump_flow(l, p, p->fd_a, p->fd_b, p->a2b, p->a_eof, p->b_wr_shut,
-                 p->bytes_a2b))
+                 p->bytes_a2b, p->b_eof && p->b2a.empty()))
     return;
   if (!pump_flow(l, p, p->fd_b, p->fd_a, p->b2a, p->b_eof, p->a_wr_shut,
-                 p->bytes_b2a))
+                 p->bytes_b2a, p->a_eof && p->a2b.empty()))
     return;
   if (p->a_eof && p->b_eof && p->a2b.empty() && p->b2a.empty()) {
     pump_kill(l, p, 0);
@@ -918,10 +969,18 @@ static void pump_fail_connect(Loop* l, Pump* p, int err) {
   p->dead = true;
   p->err = err;
   p->connect_failed = true;
-  for (int fd : {p->fd_a, p->fd_b}) {
-    auto it = l->handlers.find(fd);
+  {  // fd_a stays OPEN for the retry layer: a real DEL is required
+    auto it = l->handlers.find(p->fd_a);
     if (it != l->handlers.end()) {
-      epoll_ctl(l->ep, EPOLL_CTL_DEL, fd, nullptr);
+      if (it->second->interest != (uint32_t)-1) loop_detach(l, it->second);
+      drop_handler(l, it->second);
+      l->handlers.erase(it);
+    }
+  }
+  {
+    auto it = l->handlers.find(p->fd_b);
+    if (it != l->handlers.end()) {
+      loop_detach(l, it->second);
       drop_handler(l, it->second);
       l->handlers.erase(it);
     }
@@ -971,20 +1030,19 @@ uint64_t vtl_pump_new(void* lp, int fd_a, int fd_b, int bufsize) {
 // then splices as if vtl_pump_new had been called. A refused/unreachable
 // backend surfaces as PUMP_DONE with the connect_failed flag
 // (vtl_pump_stat2 out[3] bit0) and fd_a left open for the retry layer.
-uint64_t vtl_pump_connect(void* lp, int fd_a, const char* ip, int port,
-                          int v6, int bufsize) {
-  Loop* l = (Loop*)lp;
+static uint64_t pump_connect_impl(Loop* l, int fd_a, const sockaddr* sa,
+                                  socklen_t slen, int bufsize) {
   if (l->handlers.count(fd_a)) return 0;
-  sockaddr_storage ss;
-  socklen_t slen;
-  if (mk_addr(ip, port, v6, &ss, &slen) < 0) return 0;
+  int v6 = sa->sa_family == AF_INET6;
   int fd_b = socket(v6 ? AF_INET6 : AF_INET,
                     SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd_b < 0) return 0;
   pump_set_nodelay(fd_a, fd_b);
-  int r = connect(fd_b, (sockaddr*)&ss, slen);
+  int r = connect(fd_b, sa, slen);
   if (r < 0 && errno != EINPROGRESS) {
+    int saved = errno;
     close(fd_b);
+    errno = saved;  // lanes report the sync refusal's errno in the punt
     return 0;  // sync refusal: caller falls back to the python path
   }
   uint64_t id = l->next_pump_id++;
@@ -1000,7 +1058,11 @@ uint64_t vtl_pump_connect(void* lp, int fd_a, const char* ip, int port,
   l->valid.insert(hb);
   l->pumps[id] = p;
   if (p->b_connecting) {
-    ep_set(l, ha, 0);            // quiet until the backend resolves
+    // fd_a stays UNREGISTERED until the backend resolves (its interest
+    // is -1; the resolve path's ep_set does the ADD): one epoll_ctl
+    // fewer per session, and the client's early bytes wait in the
+    // kernel either way. A client RST mid-connect is noticed at
+    // resolve time (read error) or the connect deadline — bounded.
     ep_set(l, hb, VTL_EV_WRITE);  // connect completion
   } else {  // loopback can complete synchronously
     ep_set(l, ha, VTL_EV_READ);
@@ -1008,6 +1070,14 @@ uint64_t vtl_pump_connect(void* lp, int fd_a, const char* ip, int port,
     pump_run(l, p);
   }
   return id;
+}
+
+uint64_t vtl_pump_connect(void* lp, int fd_a, const char* ip, int port,
+                          int v6, int bufsize) {
+  sockaddr_storage ss;
+  socklen_t slen;
+  if (mk_addr(ip, port, v6, &ss, &slen) < 0) return 0;
+  return pump_connect_impl((Loop*)lp, fd_a, (sockaddr*)&ss, slen, bufsize);
 }
 
 // connect-timeout hook: if `id` is still mid-connect, fail it like a
@@ -1186,6 +1256,8 @@ int vtl_poll(void* lp, uint64_t* tags, uint32_t* evs, int max,
         }
         break;
       }
+      default:
+        break;  // LANE handlers never live on python loops
     }
   }
   flush_done();
@@ -1204,6 +1276,7 @@ void vtl_free(void* lp) {
     delete kv.second;
   }
   for (auto& kv : l->handlers) delete kv.second;
+  if (l->ur) uring_free(l->ur);
   if (l->ep >= 0) close(l->ep);
   if (l->wakefd >= 0) close(l->wakefd);
   delete l;
@@ -1673,6 +1746,307 @@ int vtl_switch_poll(void* fcp, int fd, void* buf, int slot, int maxmsgs,
   return 0;
 }
 
+// ------------------------------------------------------- io_uring engine
+//
+// The accept lanes' batched-completion engine. The ABI structs and
+// syscall numbers are defined HERE (not via <linux/io_uring.h>): this
+// container's 4.4-era kernel headers predate io_uring entirely, and the
+// build must produce BOTH engine paths everywhere — the runtime probe
+// (vtl_uring_probe) decides which one actually runs. Compiling with
+// -DVTL_NO_URING compiles the engine out (probe reports 0, lanes run
+// epoll) — the build guard compiles both configurations.
+//
+// Engine shape: one ring per lane. Readiness is delivered as oneshot
+// IORING_OP_POLL_ADD completions (re-armed per interest change), new
+// connections via multishot IORING_OP_ACCEPT (EINVAL falls back to
+// poll+accept4), and each lane_poll round is ONE io_uring_enter that
+// both submits every queued SQE (poll re-arms, cancels, the accept
+// re-arm) and reaps the whole completion batch — replacing
+// epoll_wait + one epoll_ctl syscall per interest flip. Splice/send-zc
+// opcodes are probed and reported (BENCH honesty) but the data path
+// keeps the shared ring-buffer pump; offloading it onto SPLICE/SEND_ZC
+// SQEs is future work, documented in docs/perf.md.
+
+#pragma pack(push, 1)
+struct vtl_uring_sqe {
+  uint8_t opcode, flags;
+  uint16_t ioprio;       // IORING_ACCEPT_MULTISHOT rides here
+  int32_t fd;
+  uint64_t off;          // TIMEOUT: completion count
+  uint64_t addr;         // POLL_REMOVE/ASYNC_CANCEL: target user_data
+  uint32_t len;
+  uint32_t op_flags;     // poll_events / accept_flags / timeout_flags
+  uint64_t user_data;
+  uint16_t buf_index, personality;
+  int32_t splice_fd_in;
+  uint64_t pad2[2];
+};
+struct vtl_uring_cqe { uint64_t user_data; int32_t res; uint32_t flags; };
+struct vtl_io_sqring_offsets {
+  uint32_t head, tail, ring_mask, ring_entries, flags, dropped, array,
+      resv1;
+  uint64_t resv2;
+};
+struct vtl_io_cqring_offsets {
+  uint32_t head, tail, ring_mask, ring_entries, overflow, cqes, flags,
+      resv1;
+  uint64_t resv2;
+};
+struct vtl_io_uring_params {
+  uint32_t sq_entries, cq_entries, flags, sq_thread_cpu, sq_thread_idle,
+      features, wq_fd, resv[3];
+  vtl_io_sqring_offsets sq_off;
+  vtl_io_cqring_offsets cq_off;
+};
+struct vtl_uring_probe_op { uint8_t op, resv; uint16_t flags; uint32_t resv2; };
+struct vtl_uring_probe_s {
+  uint8_t last_op, ops_len;
+  uint16_t resv;
+  uint32_t resv2[3];
+  vtl_uring_probe_op ops[64];
+};
+#pragma pack(pop)
+static_assert(sizeof(vtl_uring_sqe) == 64, "io_uring sqe ABI drifted");
+static_assert(sizeof(vtl_uring_cqe) == 16, "io_uring cqe ABI drifted");
+static_assert(sizeof(vtl_io_uring_params) == 120,
+              "io_uring params ABI drifted");
+
+#define VTL_IORING_OFF_SQ_RING 0ULL
+#define VTL_IORING_OFF_CQ_RING 0x8000000ULL
+#define VTL_IORING_OFF_SQES 0x10000000ULL
+#define VTL_IORING_ENTER_GETEVENTS 1u
+#define VTL_IORING_FEAT_SINGLE_MMAP 1u
+#define VTL_IORING_OP_POLL_ADD 6
+#define VTL_IORING_OP_POLL_REMOVE 7
+#define VTL_IORING_OP_TIMEOUT 11
+#define VTL_IORING_OP_ACCEPT 13
+#define VTL_IORING_OP_ASYNC_CANCEL 14
+#define VTL_IORING_OP_CONNECT 16
+#define VTL_IORING_OP_SPLICE 30
+#define VTL_IORING_OP_SEND_ZC 47
+#define VTL_IORING_ACCEPT_MULTISHOT 1u
+#define VTL_IORING_CQE_F_MORE 2u
+#define VTL_IORING_REGISTER_PROBE 8
+#define VTL_IO_URING_OP_SUPPORTED 1u
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+#ifndef __NR_io_uring_register
+#define __NR_io_uring_register 427
+#endif
+
+// user_data low bits tag the op; handlers come from new (>=8-aligned)
+#define VTL_UTAG_POLL 0ull
+#define VTL_UTAG_ACCEPT 1ull
+#define VTL_UTAG_CANCEL 2ull
+#define VTL_UTAG_TIMEOUT 3ull
+
+#ifdef VTL_NO_URING
+
+// probe bitmask: bit0 io_uring_setup works, bit1 ACCEPT, bit2 CONNECT,
+// bit3 POLL_ADD, bit4 SPLICE, bit5 SEND_ZC
+int vtl_uring_probe(void) { return 0; }
+static Uring* uring_new(unsigned) { return nullptr; }
+static void uring_free(Uring*) {}
+static int uring_set_interest(Loop*, Handler* h, uint32_t interest) {
+  h->interest = interest;
+  return -ENOSYS;
+}
+static void uring_detach(Loop*, Handler*) {}
+
+#else  // !VTL_NO_URING
+
+struct Uring {
+  int fd = -1;
+  unsigned sq_entries = 0;
+  unsigned *sq_head = nullptr, *sq_tail = nullptr, *sq_mask = nullptr,
+           *sq_array = nullptr;
+  unsigned *cq_head = nullptr, *cq_tail = nullptr, *cq_mask = nullptr;
+  vtl_uring_sqe* sqes = nullptr;
+  vtl_uring_cqe* cqes = nullptr;
+  void *sq_ring = nullptr, *cq_ring = nullptr;
+  size_t sq_ring_sz = 0, cq_ring_sz = 0, sqes_sz = 0;
+  unsigned to_submit = 0;
+  bool single_mmap = false;
+};
+
+static int sys_uring_setup(unsigned entries, vtl_io_uring_params* p) {
+  return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+static int sys_uring_enter(int fd, unsigned to_submit,
+                           unsigned min_complete, unsigned flags) {
+  return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete,
+                      flags, nullptr, (size_t)0);
+}
+static int sys_uring_register(int fd, unsigned op, void* arg, unsigned n) {
+  return (int)syscall(__NR_io_uring_register, fd, op, arg, n);
+}
+
+int vtl_uring_probe(void) {
+  static std::atomic<int> cached(-1);
+  int c = cached.load(std::memory_order_relaxed);
+  if (c >= 0) return c;
+  int mask = 0;
+  vtl_io_uring_params p;
+  memset(&p, 0, sizeof(p));
+  int fd = sys_uring_setup(4, &p);
+  if (fd >= 0) {
+    mask |= 1;
+    vtl_uring_probe_s pr;
+    memset(&pr, 0, sizeof(pr));
+    if (sys_uring_register(fd, VTL_IORING_REGISTER_PROBE, &pr, 64) == 0) {
+      auto sup = [&](unsigned op) {
+        return op <= pr.last_op &&
+               (pr.ops[op].flags & VTL_IO_URING_OP_SUPPORTED);
+      };
+      if (sup(VTL_IORING_OP_ACCEPT)) mask |= 2;
+      if (sup(VTL_IORING_OP_CONNECT)) mask |= 4;
+      if (sup(VTL_IORING_OP_POLL_ADD)) mask |= 8;
+      if (sup(VTL_IORING_OP_SPLICE)) mask |= 16;
+      if (sup(VTL_IORING_OP_SEND_ZC)) mask |= 32;
+    }
+    close(fd);
+  }
+  cached.store(mask, std::memory_order_relaxed);
+  return mask;
+}
+
+static void uring_free(Uring* u) {
+  if (!u) return;
+  if (u->sqes && u->sqes != MAP_FAILED) munmap(u->sqes, u->sqes_sz);
+  if (u->cq_ring && u->cq_ring != u->sq_ring) munmap(u->cq_ring, u->cq_ring_sz);
+  if (u->sq_ring && u->sq_ring != MAP_FAILED) munmap(u->sq_ring, u->sq_ring_sz);
+  if (u->fd >= 0) close(u->fd);
+  delete u;
+}
+
+static Uring* uring_new(unsigned entries) {
+  vtl_io_uring_params p;
+  memset(&p, 0, sizeof(p));
+  int fd = sys_uring_setup(entries, &p);
+  if (fd < 0) return nullptr;
+  Uring* u = new Uring();
+  u->fd = fd;
+  u->sq_entries = p.sq_entries;
+  u->sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(uint32_t);
+  u->cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(vtl_uring_cqe);
+  u->single_mmap = (p.features & VTL_IORING_FEAT_SINGLE_MMAP) != 0;
+  if (u->single_mmap)
+    u->sq_ring_sz = u->cq_ring_sz = std::max(u->sq_ring_sz, u->cq_ring_sz);
+  u->sq_ring = mmap(nullptr, u->sq_ring_sz, PROT_READ | PROT_WRITE,
+                    MAP_SHARED | MAP_POPULATE, fd, VTL_IORING_OFF_SQ_RING);
+  if (u->sq_ring == MAP_FAILED) { uring_free(u); return nullptr; }
+  u->cq_ring = u->single_mmap
+                   ? u->sq_ring
+                   : mmap(nullptr, u->cq_ring_sz, PROT_READ | PROT_WRITE,
+                          MAP_SHARED | MAP_POPULATE, fd,
+                          VTL_IORING_OFF_CQ_RING);
+  if (u->cq_ring == MAP_FAILED) { uring_free(u); return nullptr; }
+  u->sqes_sz = p.sq_entries * sizeof(vtl_uring_sqe);
+  u->sqes = (vtl_uring_sqe*)mmap(nullptr, u->sqes_sz,
+                                 PROT_READ | PROT_WRITE,
+                                 MAP_SHARED | MAP_POPULATE, fd,
+                                 VTL_IORING_OFF_SQES);
+  if (u->sqes == MAP_FAILED) { uring_free(u); return nullptr; }
+  char* s = (char*)u->sq_ring;
+  u->sq_head = (unsigned*)(s + p.sq_off.head);
+  u->sq_tail = (unsigned*)(s + p.sq_off.tail);
+  u->sq_mask = (unsigned*)(s + p.sq_off.ring_mask);
+  u->sq_array = (unsigned*)(s + p.sq_off.array);
+  char* c = (char*)u->cq_ring;
+  u->cq_head = (unsigned*)(c + p.cq_off.head);
+  u->cq_tail = (unsigned*)(c + p.cq_off.tail);
+  u->cq_mask = (unsigned*)(c + p.cq_off.ring_mask);
+  u->cqes = (vtl_uring_cqe*)(c + p.cq_off.cqes);
+  return u;
+}
+
+// next free SQE (flushing the queue if the SQ ring is full); nullptr
+// only when the kernel refuses to drain — callers degrade gracefully
+static vtl_uring_sqe* uring_sqe(Loop* l) {
+  Uring* u = l->ur;
+  unsigned head = __atomic_load_n(u->sq_head, __ATOMIC_ACQUIRE);
+  unsigned tail = *u->sq_tail;  // single producer: the lane thread
+  if (tail - head >= u->sq_entries) {
+    if (sys_uring_enter(u->fd, u->to_submit, 0, 0) >= 0) u->to_submit = 0;
+    head = __atomic_load_n(u->sq_head, __ATOMIC_ACQUIRE);
+    if (tail - head >= u->sq_entries) return nullptr;
+  }
+  unsigned idx = tail & *u->sq_mask;
+  vtl_uring_sqe* e = &u->sqes[idx];
+  memset(e, 0, sizeof(*e));
+  u->sq_array[idx] = idx;
+  __atomic_store_n(u->sq_tail, tail + 1, __ATOMIC_RELEASE);
+  u->to_submit++;
+  return e;
+}
+
+static int uring_arm_poll(Loop* l, Handler* h, uint16_t ev) {
+  vtl_uring_sqe* e = uring_sqe(l);
+  if (!e) return -EBUSY;
+  e->opcode = VTL_IORING_OP_POLL_ADD;
+  e->fd = h->fd;
+  e->op_flags = (uint32_t)(ev | POLLERR | POLLHUP);
+  e->user_data = (uint64_t)(uintptr_t)h | VTL_UTAG_POLL;
+  h->poll_pending = true;
+  h->pending_ev = ev;
+  h->inflight++;
+  return 0;
+}
+
+static int uring_set_interest(Loop* l, Handler* h, uint32_t interest) {
+  h->interest = interest;
+  if (h->kind == Handler::LANE && h->ms_accept)
+    return 0;  // multishot accept IS the readiness source
+  uint16_t ev = 0;
+  if (interest & VTL_EV_READ) ev |= POLLIN;
+  if (interest & VTL_EV_WRITE) ev |= POLLOUT;
+  if (h->poll_pending) {
+    if (h->pending_ev != ev) {
+      // armed for the wrong events: cancel; the -ECANCELED completion
+      // re-arms from the then-current interest
+      vtl_uring_sqe* e = uring_sqe(l);
+      if (!e) return -EBUSY;
+      e->opcode = VTL_IORING_OP_POLL_REMOVE;
+      e->addr = (uint64_t)(uintptr_t)h | VTL_UTAG_POLL;
+      e->user_data = (uint64_t)(uintptr_t)h | VTL_UTAG_CANCEL;
+      h->inflight++;
+      h->pending_ev = ev;  // dedupe further same-target removes
+    }
+    return 0;
+  }
+  if (!ev) return 0;
+  return uring_arm_poll(l, h, ev);
+}
+
+// before an fd closes: cancel its outstanding ring ops so the kernel
+// drops the file reference (a closed fd with a live uring poll leaks)
+static void uring_detach(Loop* l, Handler* h) {
+  if (h->poll_pending) {
+    vtl_uring_sqe* e = uring_sqe(l);
+    if (e) {
+      e->opcode = VTL_IORING_OP_POLL_REMOVE;
+      e->addr = (uint64_t)(uintptr_t)h | VTL_UTAG_POLL;
+      e->user_data = (uint64_t)(uintptr_t)h | VTL_UTAG_CANCEL;
+      h->inflight++;
+    }
+  }
+  if (h->ms_accept) {
+    vtl_uring_sqe* e = uring_sqe(l);
+    if (e) {
+      e->opcode = VTL_IORING_OP_ASYNC_CANCEL;
+      e->addr = (uint64_t)(uintptr_t)h | VTL_UTAG_ACCEPT;
+      e->user_data = (uint64_t)(uintptr_t)h | VTL_UTAG_CANCEL;
+      h->inflight++;
+    }
+  }
+}
+
+#endif  // VTL_NO_URING
+
 // Block until fd is readable or timeout_ms passes — the poller
 // threads' park (they call vtl_switch_poll on wake). ctypes releases
 // the GIL for the duration, so N pollers wait/forward in parallel.
@@ -1687,6 +2061,743 @@ int vtl_wait_readable(int fd, int timeout_ms) {
   if (r == 0) return 0;
   if (p.revents & (POLLERR | POLLNVAL)) return -EBADF;
   return 1;
+}
+
+// ---------------------------------------------------------- accept lanes
+//
+// The PR-5 switch-poller idiom applied to TCP: N lane threads (plain
+// Python threads parked inside vtl_lane_poll — ctypes releases the GIL)
+// each own a SO_REUSEPORT listener and run the WHOLE short-connection
+// lifetime in C: accept4 batch -> route lookup against the C-resident
+// lane entry (the compiled backend set + WRR sequence Python installs)
+// -> pump_connect_impl -> splice -> close. Python is the lane-entry
+// COMPILER: only punts cross ctypes — connections the lane must not
+// decide (no entry, stale generation, armed failpoints, overload) and
+// backend-connect failures (fd_a intact, feeding the retry/ejection
+// machinery exactly like vtl_pump_connect's connect_failed DONE).
+//
+// Correctness is generation-gated like the switch flow cache: every
+// upstream/ACL/backend-health mutation bumps ONE atomic
+// (vtl_lane_gen_bump, any thread); the installed entry is stamped with
+// the generation read before compilation began, and a mismatched stamp
+// is a forced punt — zero stale routing by construction.
+
+#pragma pack(push, 1)
+struct LaneRec {  // install record; must match net/vtl.py LANE_REC
+  char ip[46];
+  uint16_t port;
+  uint8_t v6;
+  uint8_t weight;  // informational (Python pre-expands the WRR seq)
+};
+struct LanePunt {  // punt record; must match net/vtl.py LANE_PUNT
+  int32_t fd;
+  int32_t kind;  // 0 classic (serve via Python), 1 connect_failed
+  int32_t err;
+  uint16_t cport, bport;
+  char cip[46];
+  char bip[46];
+};
+#pragma pack(pop)
+static_assert(sizeof(LaneRec) == 50, "LaneRec ABI drifted");
+static_assert(sizeof(LanePunt) == 108, "LanePunt ABI drifted");
+
+#define LANE_PUNT_CLASSIC 0
+#define LANE_PUNT_CONNECT_FAIL 1
+
+struct LaneRoute {
+  uint64_t gen = 0;
+  std::vector<LaneRec> backends;
+  std::vector<sockaddr_storage> addrs;  // pre-resolved: no per-accept
+  std::vector<socklen_t> lens;          // string parsing on the hot path
+  std::vector<int32_t> seq;             // WRR pick sequence
+};
+
+struct ConnMeta {  // per live lane pump (owning lane thread only)
+  std::shared_ptr<LaneRoute> route;
+  int bidx;
+  uint64_t last_total, last_ts_us;
+};
+
+struct Lanes;
+
+struct Lane {
+  Lanes* owner = nullptr;
+  Loop* loop = nullptr;
+  int lfd = -1;
+  Handler* lh = nullptr;
+  bool listener_closed = false;
+  std::deque<LanePunt> punt_q;
+  std::unordered_map<uint64_t, ConnMeta> meta;
+  uint64_t next_sweep_us = 0;
+#ifndef VTL_NO_URING
+  bool to_pending = false;  // outstanding IORING_OP_TIMEOUT
+  struct { int64_t sec, nsec; } to_ts {0, 0};  // __kernel_timespec
+#endif
+};
+
+struct Lanes {
+  std::atomic<uint64_t> gen{1};
+  std::atomic<int> punt_all{0};         // armed failpoints force classic
+  std::atomic<int> close_listeners{0};  // drain: stop accepting
+  std::atomic<int> shutting{0};
+  std::atomic<uint64_t> abort_at_us{0};
+  std::atomic<int64_t> max_active{1ll << 30};
+  std::atomic<uint64_t> wrr{0};  // shared cursor: even spread across lanes
+  std::mutex mu;                 // guards the route swap
+  std::shared_ptr<LaneRoute> route;
+  int engine = 0;  // 0 epoll, 1 uring
+  int port = 0, bufsize = 65536;
+  std::atomic<int> timeout_ms{900000};  // hot-settable (update timeout)
+  int connect_timeout_ms = 3000;
+  std::vector<Lane*> lanes;
+  std::atomic<uint64_t> accepted{0}, served{0}, active{0},
+      punt_classic{0}, punt_stale{0}, punt_fail{0}, bytes{0},
+      killed{0};  // idle-expired + shutdown-aborted (NOT served)
+};
+
+// process-global tallies (every LB's lanes), pump_counters idiom —
+// /metrics surfaces them as vproxy_lane_*_total
+static std::atomic<uint64_t> g_lane_accepted(0), g_lane_served(0),
+    g_lane_punt_classic(0), g_lane_punt_stale(0), g_lane_punt_fail(0);
+
+int vtl_lane_rec_size(void) { return (int)sizeof(LaneRec); }
+int vtl_lane_punt_size(void) { return (int)sizeof(LanePunt); }
+
+static void addr_str(const sockaddr_storage* ss, char* ip, int iplen,
+                     uint16_t* port) {
+  ip[0] = 0;
+  *port = 0;
+  if (ss->ss_family == AF_INET) {
+    auto* a = (const sockaddr_in*)ss;
+    inet_ntop(AF_INET, &a->sin_addr, ip, iplen);
+    *port = ntohs(a->sin_port);
+  } else if (ss->ss_family == AF_INET6) {
+    auto* a = (const sockaddr_in6*)ss;
+    inet_ntop(AF_INET6, &a->sin6_addr, ip, iplen);
+    *port = ntohs(a->sin6_port);
+  }
+}
+
+static void lane_emit_punt(Lane* ln, int cfd, int kind, int err,
+                           const sockaddr_storage* ss, const LaneRec* b) {
+  LanePunt p;
+  memset(&p, 0, sizeof(p));
+  p.fd = cfd;
+  p.kind = kind;
+  p.err = err;
+  sockaddr_storage local;
+  if (!ss) {  // uring multishot accept reports no peer address
+    socklen_t sl = sizeof(local);
+    if (getpeername(cfd, (sockaddr*)&local, &sl) == 0) ss = &local;
+  }
+  if (ss) addr_str(ss, p.cip, sizeof(p.cip), &p.cport);
+  if (b) {
+    memcpy(p.bip, b->ip, sizeof(p.bip));
+    p.bport = b->port;
+  }
+  ln->punt_q.push_back(p);
+}
+
+static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
+  Lanes* ow = ln->owner;
+  ow->accepted.fetch_add(1, std::memory_order_relaxed);
+  g_lane_accepted.fetch_add(1, std::memory_order_relaxed);
+  std::shared_ptr<LaneRoute> rt;
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    rt = ow->route;
+  }
+  uint64_t cur = ow->gen.load(std::memory_order_relaxed);
+  if (ow->punt_all.load(std::memory_order_relaxed) ||
+      ow->close_listeners.load(std::memory_order_relaxed) || !rt ||
+      rt->seq.empty() ||
+      (int64_t)ow->active.load(std::memory_order_relaxed) >=
+          ow->max_active.load(std::memory_order_relaxed)) {
+    ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
+    g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
+    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+    return;
+  }
+  if (rt->gen != cur) {
+    // the generation gate: a mutation since compile forces the classic
+    // path; Python re-decides against current tables and re-installs
+    ow->punt_stale.fetch_add(1, std::memory_order_relaxed);
+    g_lane_punt_stale.fetch_add(1, std::memory_order_relaxed);
+    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+    return;
+  }
+  int bidx = rt->seq[ow->wrr.fetch_add(1, std::memory_order_relaxed) %
+                     rt->seq.size()];
+  errno = 0;
+  uint64_t pid = pump_connect_impl(ln->loop, cfd,
+                                   (sockaddr*)&rt->addrs[bidx],
+                                   rt->lens[bidx], ow->bufsize);
+  if (!pid) {  // sync refusal: punt as connect failure (retry machinery)
+    ow->punt_fail.fetch_add(1, std::memory_order_relaxed);
+    g_lane_punt_fail.fetch_add(1, std::memory_order_relaxed);
+    lane_emit_punt(ln, cfd, LANE_PUNT_CONNECT_FAIL,
+                   errno ? errno : ECONNREFUSED, ss, &rt->backends[bidx]);
+    return;
+  }
+  ln->meta[pid] = ConnMeta{rt, bidx, 0, mono_us()};
+  ow->active.fetch_add(1, std::memory_order_relaxed);
+}
+
+static void lane_accept_batch(Lane* ln) {
+  for (;;) {  // drain the backlog: one wake pays for the whole burst
+    sockaddr_storage ss;
+    socklen_t sl = sizeof(ss);
+    int cfd = accept4(ln->lfd, (sockaddr*)&ss, &sl,
+                      SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (cfd < 0) break;  // EAGAIN (or EMFILE — retried on the next wake)
+    lane_client(ln, cfd, &ss);
+  }
+}
+
+#ifndef VTL_NO_URING
+static void lane_arm_accept(Lane* ln) {
+  Handler* h = ln->lh;
+  vtl_uring_sqe* e = uring_sqe(ln->loop);
+  if (!e) return;
+  e->opcode = VTL_IORING_OP_ACCEPT;
+  e->fd = h->fd;
+  e->ioprio = VTL_IORING_ACCEPT_MULTISHOT;
+  e->op_flags = SOCK_NONBLOCK | SOCK_CLOEXEC;
+  e->user_data = (uint64_t)(uintptr_t)h | VTL_UTAG_ACCEPT;
+  h->ms_accept = true;
+  h->inflight++;
+}
+#endif
+
+// reap DONE pumps: connect failures become punts (fd_a intact), clean
+// completions count as served — Python never sees these pump ids
+static void lane_reap(Lane* ln) {
+  Loop* l = ln->loop;
+  Lanes* ow = ln->owner;
+  for (uint64_t id : l->done_pumps) {
+    auto it = l->pumps.find(id);
+    if (it == l->pumps.end()) continue;
+    Pump* p = it->second;
+    auto mit = ln->meta.find(id);
+    if (p->connect_failed) {
+      ow->punt_fail.fetch_add(1, std::memory_order_relaxed);
+      g_lane_punt_fail.fetch_add(1, std::memory_order_relaxed);
+      const LaneRec* b = (mit != ln->meta.end() && mit->second.route)
+                             ? &mit->second.route->backends[mit->second.bidx]
+                             : nullptr;
+      lane_emit_punt(ln, p->fd_a, LANE_PUNT_CONNECT_FAIL, p->err, nullptr,
+                     b);
+    } else if (p->err == ECANCELED) {
+      // lane-initiated kill (idle expiry / shutdown abort): a real
+      // session, but NOT a served one — hit_rate must not count it
+      ow->killed.fetch_add(1, std::memory_order_relaxed);
+      ow->bytes.fetch_add(p->bytes_a2b + p->bytes_b2a,
+                          std::memory_order_relaxed);
+    } else {
+      ow->served.fetch_add(1, std::memory_order_relaxed);
+      g_lane_served.fetch_add(1, std::memory_order_relaxed);
+      ow->bytes.fetch_add(p->bytes_a2b + p->bytes_b2a,
+                          std::memory_order_relaxed);
+    }
+    if (mit != ln->meta.end()) {
+      ow->active.fetch_sub(1, std::memory_order_relaxed);
+      ln->meta.erase(mit);
+    }
+    delete p;
+    l->pumps.erase(it);
+  }
+  l->done_pumps.clear();
+}
+
+// connect deadline + idle timeout, the lane-local analog of the python
+// sweep in TcpLB._arm_sweep (250ms cadence)
+static void lane_sweep(Lane* ln, uint64_t now) {
+  if (now < ln->next_sweep_us) return;
+  ln->next_sweep_us = now + 250000;
+  Lanes* ow = ln->owner;
+  uint64_t cto = (uint64_t)ow->connect_timeout_ms * 1000;
+  uint64_t idle =
+      (uint64_t)ow->timeout_ms.load(std::memory_order_relaxed) * 1000;
+  for (auto& kv : ln->meta) {
+    auto pit = ln->loop->pumps.find(kv.first);
+    if (pit == ln->loop->pumps.end()) continue;
+    Pump* p = pit->second;
+    if (p->dead) continue;
+    if (p->b_connecting) {
+      if (now - p->created_us >= cto)
+        pump_fail_connect(ln->loop, p, ETIMEDOUT);
+      continue;
+    }
+    uint64_t total = p->bytes_a2b + p->bytes_b2a;
+    if (total != kv.second.last_total) {
+      kv.second.last_total = total;
+      kv.second.last_ts_us = now;
+    } else if (now - kv.second.last_ts_us >= idle) {
+      // ECANCELED marks lane-initiated kills (idle expiry here, the
+      // shutdown grace abort) so reap counts them as killed, not served
+      pump_kill(ln->loop, p, ECANCELED);
+    }
+  }
+}
+
+// free torn-down handlers — but never while the ring still owes them
+// CQEs (uring user_data holds the raw pointer)
+static void lane_gc(Loop* l) {
+  size_t w = 0;
+  for (size_t i = 0; i < l->garbage.size(); ++i) {
+    Handler* h = l->garbage[i];
+    if (h->inflight == 0)
+      delete h;
+    else
+      l->garbage[w++] = h;
+  }
+  l->garbage.resize(w);
+}
+
+static void lane_event(Lane* ln, Handler* h, uint32_t e) {
+  Loop* l = ln->loop;
+  switch (h->kind) {
+    case Handler::WAKE: {
+      uint64_t v;
+      while (read(l->wakefd, &v, 8) == 8) {}
+      break;
+    }
+    case Handler::LANE:
+      if (!ln->listener_closed) lane_accept_batch(ln);
+      break;
+    case Handler::PUMP_A:
+    case Handler::PUMP_B: {
+      Pump* p = h->pump;
+      if (h->kind == Handler::PUMP_B && p->b_connecting) {
+        // same contract as vtl_poll: SO_ERROR decides; EPOLLHUP with
+        // SO_ERROR==0 is a successful connect whose peer already closed
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(h->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if (err) {
+          pump_fail_connect(l, p, err);
+        } else {
+          p->b_connecting = false;
+          p->connect_us = mono_us() - p->created_us;
+          Handler* ha =
+              l->handlers.count(p->fd_a) ? l->handlers[p->fd_a] : nullptr;
+          if (ha) ep_set(l, ha, VTL_EV_READ);
+          ep_set(l, h, VTL_EV_READ);
+          pump_run(l, p);
+        }
+        break;
+      }
+      if (e & EPOLLERR) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(h->fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        pump_kill(l, p, err ? err : EIO);
+      } else {
+        pump_run(l, p);
+      }
+      break;
+    }
+    default:
+      break;
+  }
+}
+
+static void lane_wait_epoll(Lane* ln, int timeout_ms) {
+  epoll_event eps[256];
+  int n = epoll_wait(ln->loop->ep, eps, 256, timeout_ms);
+  for (int i = 0; i < n; ++i) {
+    Handler* h = (Handler*)eps[i].data.ptr;
+    if (!ln->loop->valid.count(h)) continue;
+    lane_event(ln, h, eps[i].events);
+  }
+}
+
+#ifndef VTL_NO_URING
+static void lane_cqe(Lane* ln, vtl_uring_cqe* c) {
+  Loop* l = ln->loop;
+  uint64_t ud = c->user_data;
+  if ((ud & 7) == VTL_UTAG_TIMEOUT) {
+    ln->to_pending = false;
+    return;
+  }
+  Handler* h = (Handler*)(uintptr_t)(ud & ~7ull);
+  int tag = (int)(ud & 7);
+  bool valid = l->valid.count(h) != 0;
+  if (tag == (int)VTL_UTAG_CANCEL) {
+    h->inflight--;
+    return;
+  }
+  if (tag == (int)VTL_UTAG_ACCEPT) {
+    bool more = (c->flags & VTL_IORING_CQE_F_MORE) != 0;
+    if (!more) {
+      h->inflight--;
+      h->ms_accept = false;
+    }
+    if (c->res >= 0) {
+      if (valid && !ln->listener_closed)
+        lane_client(ln, c->res, nullptr);
+      else
+        close(c->res);
+    } else if (c->res == -EINVAL && valid && !ln->listener_closed) {
+      // kernel without multishot accept: poll + accept4 batch instead
+      ep_set(l, h, VTL_EV_READ);
+      return;
+    }
+    if (!more && valid && !ln->listener_closed && !h->ms_accept &&
+        c->res != -ECANCELED)
+      lane_arm_accept(ln);
+    return;
+  }
+  // oneshot poll completion
+  h->inflight--;
+  h->poll_pending = false;
+  if (!valid) return;
+  if (c->res > 0) lane_event(ln, h, (uint32_t)c->res);
+  if (l->valid.count(h) && !h->poll_pending) {
+    // re-arm per the CURRENT interest (dispatch may have changed it)
+    uint16_t ev = 0;
+    if (h->interest != (uint32_t)-1) {
+      if (h->interest & VTL_EV_READ) ev |= POLLIN;
+      if (h->interest & VTL_EV_WRITE) ev |= POLLOUT;
+    }
+    if (ev) uring_arm_poll(l, h, ev);
+  }
+}
+
+static void lane_wait_uring(Lane* ln, int timeout_ms) {
+  Loop* l = ln->loop;
+  Uring* u = l->ur;
+  if (!ln->to_pending) {
+    // a TIMEOUT op bounds the enter (completes after 1 CQE or timeout);
+    // ts lives on the Lane so the kernel's reference stays valid
+    vtl_uring_sqe* e = uring_sqe(l);
+    if (e) {
+      ln->to_ts.sec = timeout_ms / 1000;
+      ln->to_ts.nsec = (int64_t)(timeout_ms % 1000) * 1000000;
+      e->opcode = VTL_IORING_OP_TIMEOUT;
+      e->fd = -1;
+      e->addr = (uint64_t)(uintptr_t)&ln->to_ts;
+      e->len = 1;
+      e->off = 1;
+      e->user_data = VTL_UTAG_TIMEOUT;
+      ln->to_pending = true;
+    }
+  }
+  int r = sys_uring_enter(u->fd, u->to_submit, 1,
+                          VTL_IORING_ENTER_GETEVENTS);
+  if (r >= 0) u->to_submit = 0;
+  unsigned head = *u->cq_head;
+  unsigned tail = __atomic_load_n(u->cq_tail, __ATOMIC_ACQUIRE);
+  while (head != tail) {
+    lane_cqe(ln, &u->cqes[head & *u->cq_mask]);
+    ++head;
+  }
+  __atomic_store_n(u->cq_head, head, __ATOMIC_RELEASE);
+}
+#endif  // !VTL_NO_URING
+
+static void lane_abort_all(Lane* ln) {
+  for (auto& kv : ln->loop->pumps)
+    if (!kv.second->dead) pump_kill(ln->loop, kv.second, ECANCELED);
+}
+
+static Loop* lane_loop_new(bool uring) {
+  Loop* l = new Loop();
+  l->ep = epoll_create1(EPOLL_CLOEXEC);
+  l->wakefd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (uring) l->ur = uring_new(256);  // nullptr -> epoll fallback
+  Handler* h = new Handler{Handler::WAKE, 0, nullptr, l->wakefd,
+                           (uint32_t)-1};
+  l->handlers[l->wakefd] = h;
+  l->valid.insert(h);
+  ep_set(l, h, VTL_EV_READ);
+  return l;
+}
+
+int vtl_lanes_free(void* lp);
+
+// why the last vtl_lanes_new on THIS thread returned NULL: a real
+// errno (bind/EMFILE) or EINVAL for bad args — Python surfaces it so
+// a config error is not misreported as a port conflict
+static thread_local int g_lanes_err = 0;
+int vtl_lanes_errno(void) { return g_lanes_err; }
+
+// -> Lanes handle or NULL (bind failure / bad args). engine_req: 0
+// forces epoll, 1 uses io_uring when the runtime probe allows it.
+// defer_accept_s > 0 arms TCP_DEFER_ACCEPT on every lane listener
+// (client-speaks-first workloads: empty accepts never wake a lane).
+void* vtl_lanes_new(const char* ip, int port, int backlog, int nlanes,
+                    int bufsize, int engine_req, int timeout_ms,
+                    int connect_timeout_ms, int defer_accept_s) {
+  if (nlanes <= 0 || nlanes > 64) {
+    g_lanes_err = EINVAL;
+    return nullptr;
+  }
+  Lanes* ow = new Lanes();
+  if (bufsize > 0) ow->bufsize = bufsize;
+  if (timeout_ms > 0) ow->timeout_ms = timeout_ms;
+  if (connect_timeout_ms > 0) ow->connect_timeout_ms = connect_timeout_ms;
+  int probe = vtl_uring_probe();
+  bool uring = engine_req && (probe & 1) && (probe & 2) && (probe & 8);
+  int v6 = strchr(ip, ':') != nullptr;
+  for (int i = 0; i < nlanes; ++i) {
+    int lfd = vtl_tcp_listen(ip, port, backlog, 1, v6);
+    if (lfd < 0) {
+      g_lanes_err = -lfd;
+      vtl_lanes_free(ow);
+      return nullptr;
+    }
+    if (defer_accept_s > 0)
+      setsockopt(lfd, IPPROTO_TCP, TCP_DEFER_ACCEPT, &defer_accept_s,
+                 sizeof(defer_accept_s));
+    if (port == 0) {  // first lane resolves the ephemeral port
+      sockaddr_storage ss;
+      socklen_t sl = sizeof(ss);
+      if (getsockname(lfd, (sockaddr*)&ss, &sl) == 0)
+        port = ss.ss_family == AF_INET6
+                   ? ntohs(((sockaddr_in6*)&ss)->sin6_port)
+                   : ntohs(((sockaddr_in*)&ss)->sin_port);
+    }
+    Lane* ln = new Lane();
+    ln->owner = ow;
+    ln->lfd = lfd;
+    ln->loop = lane_loop_new(uring);
+    if (i == 0 && uring && !ln->loop->ur) uring = false;  // setup refused
+    Handler* h = new Handler{Handler::LANE, (uint64_t)i, nullptr, lfd,
+                             (uint32_t)-1};
+    ln->lh = h;
+    ln->loop->handlers[lfd] = h;
+    ln->loop->valid.insert(h);
+#ifndef VTL_NO_URING
+    if (ln->loop->ur)
+      lane_arm_accept(ln);
+    else
+#endif
+      ep_set(ln->loop, h, VTL_EV_READ);
+    ow->lanes.push_back(ln);
+  }
+  // engine honesty: report uring ONLY when every lane actually got a
+  // ring (a tight RLIMIT_MEMLOCK can fail ring N after ring 0 worked;
+  // that lane runs epoll and the artifact must not claim otherwise)
+  ow->engine = 1;
+  for (Lane* ln : ow->lanes)
+    if (!ln->loop->ur) ow->engine = 0;
+  if (ow->lanes.empty()) ow->engine = 0;
+  ow->port = port;
+  return ow;
+}
+
+int vtl_lanes_port(void* lp) { return ((Lanes*)lp)->port; }
+
+// one atomic load — the per-accept overload check's read (the 11-field
+// stat is for list-detail/HTTP, not the hot path)
+long long vtl_lanes_active(void* lp) {
+  if (!lp) return 0;
+  return (long long)((Lanes*)lp)->active.load(std::memory_order_relaxed);
+}
+int vtl_lanes_engine(void* lp) { return ((Lanes*)lp)->engine; }
+
+uint64_t vtl_lane_gen(void* lp) {
+  return ((Lanes*)lp)->gen.load(std::memory_order_relaxed);
+}
+
+// ONE atomic — safe from any thread; every upstream/ACL/backend-health
+// mutation calls this (the lane-entry analog of vtl_switch_gen_bump)
+void vtl_lane_gen_bump(void* lp) {
+  ((Lanes*)lp)->gen.fetch_add(1, std::memory_order_relaxed);
+}
+
+// Install the compiled lane entry, stamped with the generation read
+// BEFORE compilation began. -EAGAIN when a mutation raced the compile
+// (Python recompiles against current state); otherwise the usable WRR
+// sequence length (0 = punt-everything entry, e.g. non-trivial ACL).
+int vtl_lane_install(void* lp, const void* recs, int n,
+                     const int32_t* seq, int nseq, uint64_t gen) {
+  Lanes* ow = (Lanes*)lp;
+  if (gen != ow->gen.load(std::memory_order_relaxed)) return -EAGAIN;
+  auto rt = std::make_shared<LaneRoute>();
+  rt->gen = gen;
+  const LaneRec* r = (const LaneRec*)recs;
+  std::vector<int32_t> remap((size_t)(n > 0 ? n : 0), -1);
+  for (int i = 0; i < n; ++i) {
+    char ipb[48];
+    memcpy(ipb, r[i].ip, 46);
+    ipb[46] = 0;
+    sockaddr_storage ss;
+    socklen_t sl;
+    if (mk_addr(ipb, r[i].port, r[i].v6, &ss, &sl) < 0) continue;
+    remap[i] = (int32_t)rt->backends.size();
+    rt->backends.push_back(r[i]);
+    rt->addrs.push_back(ss);
+    rt->lens.push_back(sl);
+  }
+  for (int j = 0; j < nseq; ++j)
+    if (seq[j] >= 0 && seq[j] < n && remap[seq[j]] >= 0)
+      rt->seq.push_back(remap[seq[j]]);
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    ow->route = rt;
+  }
+  return (int)rt->seq.size();
+}
+
+int vtl_lanes_set_punt_all(void* lp, int on) {
+  ((Lanes*)lp)->punt_all.store(on ? 1 : 0, std::memory_order_relaxed);
+  return 0;
+}
+
+// hot-set the idle timeout (`update tcp-lb ... timeout` must govern
+// lane-owned sessions too; the sweep reads it per pass)
+int vtl_lanes_set_timeout(void* lp, int timeout_ms) {
+  if (!lp || timeout_ms <= 0) return -EINVAL;
+  ((Lanes*)lp)->timeout_ms.store(timeout_ms, std::memory_order_relaxed);
+  return 0;
+}
+
+// n >= 0 is the REMAINING session budget (Python forwards
+// max_sessions - its own active count, so the ceiling is shared across
+// both admission paths); 0 = admit none (punt everything); n < 0
+// restores the effectively-unlimited default.
+int vtl_lanes_set_limit(void* lp, long long n) {
+  ((Lanes*)lp)->max_active.store(n >= 0 ? n : (1ll << 30),
+                                 std::memory_order_relaxed);
+  return 0;
+}
+
+// out: accepted, served, active, punt_classic, punt_stale, punt_fail,
+// bytes, gen, engine, port, killed -> 11 (this Lanes object only)
+int vtl_lanes_stat(void* lp, uint64_t* out) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow) return -EINVAL;
+  out[0] = ow->accepted.load(std::memory_order_relaxed);
+  out[1] = ow->served.load(std::memory_order_relaxed);
+  out[2] = ow->active.load(std::memory_order_relaxed);
+  out[3] = ow->punt_classic.load(std::memory_order_relaxed);
+  out[4] = ow->punt_stale.load(std::memory_order_relaxed);
+  out[5] = ow->punt_fail.load(std::memory_order_relaxed);
+  out[6] = ow->bytes.load(std::memory_order_relaxed);
+  out[7] = ow->gen.load(std::memory_order_relaxed);
+  out[8] = (uint64_t)ow->engine;
+  out[9] = (uint64_t)ow->port;
+  out[10] = ow->killed.load(std::memory_order_relaxed);
+  return 11;
+}
+
+// process-global: accepted, served, punt_classic, punt_stale, punt_fail
+int vtl_lane_counters(uint64_t* out) {
+  out[0] = g_lane_accepted.load(std::memory_order_relaxed);
+  out[1] = g_lane_served.load(std::memory_order_relaxed);
+  out[2] = g_lane_punt_classic.load(std::memory_order_relaxed);
+  out[3] = g_lane_punt_stale.load(std::memory_order_relaxed);
+  out[4] = g_lane_punt_fail.load(std::memory_order_relaxed);
+  return 5;
+}
+
+static void lanes_wake(Lanes* ow) {
+  for (Lane* ln : ow->lanes) {
+    uint64_t one = 1;
+    ssize_t r = write(ln->loop->wakefd, &one, 8);
+    (void)r;
+  }
+}
+
+// drain: each lane closes its OWN listener at the next poll tick (a
+// cross-thread close would race fd reuse); live pumps run on
+int vtl_lanes_close_listeners(void* lp) {
+  Lanes* ow = (Lanes*)lp;
+  ow->close_listeners.store(1, std::memory_order_relaxed);
+  lanes_wake(ow);
+  return 0;
+}
+
+// stop: listeners close, pumps get grace_ms to finish, then die; each
+// lane thread's vtl_lane_poll returns -ESHUTDOWN once its loop is empty
+int vtl_lanes_shutdown(void* lp, int grace_ms) {
+  Lanes* ow = (Lanes*)lp;
+  ow->close_listeners.store(1, std::memory_order_relaxed);
+  ow->abort_at_us.store(mono_us() + (uint64_t)(grace_ms > 0 ? grace_ms : 0)
+                                        * 1000,
+                        std::memory_order_relaxed);
+  ow->shutting.store(1, std::memory_order_relaxed);
+  lanes_wake(ow);
+  return 0;
+}
+
+// after every lane thread observed -ESHUTDOWN (python joins them first)
+int vtl_lanes_free(void* lp) {
+  Lanes* ow = (Lanes*)lp;
+  for (Lane* ln : ow->lanes) {
+    if (ln->lfd >= 0) close(ln->lfd);
+    vtl_free(ln->loop);
+    delete ln;
+  }
+  delete ow;
+  return 0;
+}
+
+// The lane thread's park: runs the whole accept->route->splice lifetime
+// in C for up to timeout_ms, returning early with punt records the
+// moment any connection needs Python. -> punt count, 0 on timeout,
+// -ESHUTDOWN when the lane drained after vtl_lanes_shutdown.
+int vtl_lane_poll(void* lp, int idx, void* punts_out, int max_punts,
+                  int timeout_ms) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || idx < 0 || idx >= (int)ow->lanes.size() || max_punts <= 0)
+    return -EINVAL;
+  Lane* ln = ow->lanes[idx];
+  Loop* l = ln->loop;
+  uint64_t deadline =
+      mono_us() + (uint64_t)(timeout_ms > 0 ? timeout_ms : 0) * 1000;
+  LanePunt* out = (LanePunt*)punts_out;
+  for (;;) {
+    lane_gc(l);
+    lane_reap(ln);
+    if (!ln->punt_q.empty()) {
+      int n = 0;
+      while (n < max_punts && !ln->punt_q.empty()) {
+        out[n++] = ln->punt_q.front();
+        ln->punt_q.pop_front();
+      }
+      return n;
+    }
+    if (ow->close_listeners.load(std::memory_order_relaxed) &&
+        !ln->listener_closed) {
+      ln->listener_closed = true;
+      auto it = l->handlers.find(ln->lfd);
+      if (it != l->handlers.end()) {
+        loop_detach(l, it->second);
+        drop_handler(l, it->second);
+        l->handlers.erase(it);
+      }
+      close(ln->lfd);
+      ln->lfd = -1;
+      ln->lh = nullptr;
+    }
+    if (ow->shutting.load(std::memory_order_relaxed)) {
+      uint64_t ab = ow->abort_at_us.load(std::memory_order_relaxed);
+      if (ab && mono_us() >= ab && !l->pumps.empty()) {
+        lane_abort_all(ln);
+        lane_reap(ln);
+        if (!ln->punt_q.empty()) continue;  // deliver before exiting
+      }
+      if (l->pumps.empty()) return -ESHUTDOWN;
+    }
+    uint64_t now = mono_us();
+    lane_sweep(ln, now);
+    lane_reap(ln);
+    if (!ln->punt_q.empty()) continue;
+    if (now >= deadline) return 0;
+    uint64_t until = std::min(deadline, ln->next_sweep_us);
+    int wait_ms = until > now ? (int)((until - now) / 1000) : 0;
+    if (wait_ms < 1) wait_ms = 1;
+    if (wait_ms > 250) wait_ms = 250;
+#ifndef VTL_NO_URING
+    if (l->ur)
+      lane_wait_uring(ln, wait_ms);
+    else
+#endif
+      lane_wait_epoll(ln, wait_ms);
+  }
 }
 
 }  // extern "C"

@@ -104,6 +104,35 @@ def _load() -> ctypes.CDLL:
     lib.vtl_sendmmsg.argtypes = [c, ctypes.POINTER(ctypes.c_char_p),
                                  ctypes.POINTER(c), c, ctypes.c_char_p,
                                  c, c]
+    try:  # accept lanes (absent from a prebuilt pre-r9 .so)
+        lib.vtl_lanes_new.argtypes = [ctypes.c_char_p, c, c, c, c, c, c,
+                                      c, c]
+        lib.vtl_lanes_new.restype = p
+        lib.vtl_lanes_free.argtypes = [p]
+        lib.vtl_lanes_close_listeners.argtypes = [p]
+        lib.vtl_lanes_shutdown.argtypes = [p, c]
+        lib.vtl_lanes_port.argtypes = [p]
+        lib.vtl_lanes_engine.argtypes = [p]
+        lib.vtl_lanes_errno.argtypes = []
+        lib.vtl_lanes_active.argtypes = [p]
+        lib.vtl_lanes_active.restype = ctypes.c_longlong
+        lib.vtl_lanes_set_punt_all.argtypes = [p, c]
+        lib.vtl_lanes_set_limit.argtypes = [p, ctypes.c_longlong]
+        lib.vtl_lanes_set_timeout.argtypes = [p, c]
+        lib.vtl_lanes_stat.argtypes = [p, ctypes.POINTER(u64)]
+        lib.vtl_lane_counters.argtypes = [ctypes.POINTER(u64)]
+        lib.vtl_lane_gen.argtypes = [p]
+        lib.vtl_lane_gen.restype = u64
+        lib.vtl_lane_gen_bump.argtypes = [p]
+        lib.vtl_lane_install.argtypes = [p, ctypes.c_char_p, c,
+                                         ctypes.POINTER(ctypes.c_int32), c,
+                                         u64]
+        lib.vtl_lane_poll.argtypes = [p, c, ctypes.c_void_p, c, c]
+        lib.vtl_lane_rec_size.argtypes = []
+        lib.vtl_lane_punt_size.argtypes = []
+        lib.vtl_uring_probe.argtypes = []
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -565,6 +594,194 @@ def switch_poll(handle: int, fd: int):
         out.append((ctypes.string_at(base + i * _MMSG_SLOT, lens[i]),
                     ip, ports[i]))
     return drained.value - n, out
+
+
+# --------------------------------------------------------- accept lanes
+#
+# The C accept plane (native/vtl.cpp "accept lanes"): N lane threads own
+# SO_REUSEPORT listeners and run the whole short-connection lifetime —
+# accept4 batch, route lookup against the C-resident lane entry, backend
+# connect, splice, close — without crossing ctypes. Python is the
+# lane-entry COMPILER (components/lanes.py): it installs the resolved
+# backend set + WRR sequence stamped with the generation read before
+# compilation, and every mutation bumps one C atomic so a stale entry is
+# a forced punt. vtl_lane_poll is the lane thread's park (GIL released);
+# it returns punt records for the connections Python must serve.
+
+# ip 46s, port u16, v6 u8, weight u8 — must match the C LaneRec
+LANE_REC = struct.Struct("<46sHBB")
+# fd i32, kind i32, err i32, cport u16, bport u16, cip 46s, bip 46s
+LANE_PUNT = struct.Struct("<iiiHH46s46s")
+LANE_PUNT_CLASSIC = 0
+LANE_PUNT_CONNECT_FAIL = 1
+ESHUTDOWN = -errno.ESHUTDOWN
+
+_lanes_supported: bool = None  # type: ignore[assignment]
+
+
+def lanes_supported() -> bool:
+    """Native provider with the lane symbols AND matching record ABIs
+    (a stale committed .so fails the size checks and TcpLB silently
+    stays on the classic accept path)."""
+    global _lanes_supported
+    if _lanes_supported is None:
+        ok = PROVIDER == "native" and hasattr(LIB, "vtl_lanes_new")
+        if ok:
+            try:
+                ok = (int(LIB.vtl_lane_rec_size()) == LANE_REC.size
+                      and int(LIB.vtl_lane_punt_size()) == LANE_PUNT.size)
+            except Exception:
+                ok = False
+        _lanes_supported = ok
+    return _lanes_supported
+
+
+def uring_probe() -> int:
+    """Runtime io_uring capability bitmask: bit0 io_uring_setup works,
+    bit1 ACCEPT, bit2 CONNECT, bit3 POLL_ADD, bit4 SPLICE, bit5 SEND_ZC.
+    0 on kernels without io_uring (this container's 4.4) or a .so built
+    with -DVTL_NO_URING — the lanes then run the epoll engine."""
+    if PROVIDER != "native" or not hasattr(LIB, "vtl_uring_probe"):
+        return 0
+    return int(LIB.vtl_uring_probe())
+
+
+def uring_probe_fields() -> dict:
+    """The probe as named BENCH/artifact fields."""
+    m = uring_probe()
+    return {"setup": bool(m & 1), "accept": bool(m & 2),
+            "connect": bool(m & 4), "poll": bool(m & 8),
+            "splice": bool(m & 16), "send_zc": bool(m & 32)}
+
+
+def lanes_new(ip: str, port: int, backlog: int, nlanes: int, bufsize: int,
+              uring: bool, timeout_ms: int, connect_timeout_ms: int) -> int:
+    """-> lanes handle; raises OSError on bind failure. Lane listeners
+    honor the same VPROXY_TPU_DEFER_ACCEPT knob as tcp_listen."""
+    h = LIB.vtl_lanes_new(ip.encode(), port, backlog, nlanes, bufsize,
+                          1 if uring else 0, timeout_ms,
+                          connect_timeout_ms, defer_accept_secs())
+    if not h:
+        # the real reason (EINVAL bad lane count, EMFILE, EADDRINUSE...)
+        # — a config error must not masquerade as a port conflict
+        err = 0
+        try:
+            err = int(LIB.vtl_lanes_errno())
+        except AttributeError:
+            pass
+        err = err or errno.EADDRINUSE
+        raise OSError(err, f"accept lanes ({nlanes}) on {ip}:{port}: "
+                      f"{os.strerror(err)}")
+    return h
+
+
+def lanes_active(handle: int) -> int:
+    """Live lane-owned sessions — ONE atomic load (the per-accept
+    overload check's read; lanes_stat is the detail surface)."""
+    return int(LIB.vtl_lanes_active(handle))
+
+
+def lanes_port(handle: int) -> int:
+    return int(LIB.vtl_lanes_port(handle))
+
+
+def lanes_engine(handle: int) -> str:
+    return "uring" if LIB.vtl_lanes_engine(handle) else "epoll"
+
+
+def lanes_close_listeners(handle: int) -> None:
+    LIB.vtl_lanes_close_listeners(handle)
+
+
+def lanes_shutdown(handle: int, grace_ms: int = 500) -> None:
+    LIB.vtl_lanes_shutdown(handle, grace_ms)
+
+
+def lanes_free(handle: int) -> None:
+    if handle:
+        LIB.vtl_lanes_free(handle)
+
+
+def lanes_set_punt_all(handle: int, on: bool) -> None:
+    LIB.vtl_lanes_set_punt_all(handle, 1 if on else 0)
+
+
+def lanes_set_limit(handle: int, n: int) -> None:
+    LIB.vtl_lanes_set_limit(handle, n)
+
+
+def lanes_set_timeout(handle: int, timeout_ms: int) -> None:
+    """Hot-set the lane idle timeout (`update tcp-lb ... timeout`)."""
+    LIB.vtl_lanes_set_timeout(handle, timeout_ms)
+
+
+def lane_gen(handle: int) -> int:
+    return int(LIB.vtl_lane_gen(handle))
+
+
+def lane_gen_bump(handle: int) -> None:
+    """One C atomic — safe from any thread, called on every mutation."""
+    LIB.vtl_lane_gen_bump(handle)
+
+
+def lane_install(handle: int, packed: bytes, n: int, seq: list,
+                 gen: int) -> int:
+    """Install n LANE_REC backends + the WRR pick sequence, stamped with
+    `gen` (read before the compile); -> usable sequence length, or
+    -EAGAIN when a mutation raced the compile (caller recompiles)."""
+    arr = (ctypes.c_int32 * len(seq))(*seq)
+    return int(LIB.vtl_lane_install(handle, packed, n, arr, len(seq), gen))
+
+
+def lanes_stat(handle: int) -> tuple:
+    """(accepted, served, active, punt_classic, punt_stale, punt_fail,
+    bytes, gen, engine, port, killed) for ONE lanes object — killed =
+    lane-initiated teardowns (idle expiry, shutdown aborts), counted
+    apart from served so hit_rate stays honest."""
+    out = (ctypes.c_uint64 * 11)()
+    n = check(LIB.vtl_lanes_stat(handle, out))
+    return tuple(int(out[i]) for i in range(n))
+
+
+def lane_counters() -> tuple:
+    """(accepted, served, punt_classic, punt_stale, punt_fail) —
+    process-global C atomics; zeros without the lanes .so."""
+    if not lanes_supported():
+        return (0,) * 5
+    out = (ctypes.c_uint64 * 5)()
+    LIB.vtl_lane_counters(out)
+    return tuple(int(x) for x in out)
+
+
+_LANE_PUNT_MAX = 128
+_lane_tls = None  # per-thread punt buffers (each lane thread has its own)
+
+
+def lane_poll(handle: int, idx: int, timeout_ms: int):
+    """Park the lane thread in C for up to timeout_ms. -> list of punt
+    tuples (fd, kind, err, cip, cport, bip, bport), [] on timeout, or
+    None once the lane drained after lanes_shutdown (thread exits)."""
+    global _lane_tls
+    if _lane_tls is None:
+        import threading
+        _lane_tls = threading.local()
+    buf = getattr(_lane_tls, "buf", None)
+    if buf is None:
+        buf = _lane_tls.buf = ctypes.create_string_buffer(
+            LANE_PUNT.size * _LANE_PUNT_MAX)
+    n = LIB.vtl_lane_poll(handle, idx, buf, _LANE_PUNT_MAX, timeout_ms)
+    if n == ESHUTDOWN:
+        return None
+    if n < 0:
+        check(n)
+    out = []
+    for i in range(n):
+        fd, kind, err, cport, bport, cip, bip = LANE_PUNT.unpack_from(
+            buf, i * LANE_PUNT.size)
+        out.append((fd, kind, err,
+                    cip.split(b"\0", 1)[0].decode(), cport,
+                    bip.split(b"\0", 1)[0].decode(), bport))
+    return out
 
 
 def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:

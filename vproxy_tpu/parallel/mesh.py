@@ -70,6 +70,7 @@ def init_distributed(coordinator: Optional[str] = None,
     if process_id > 0:
         _preflight_coordinator(coordinator, num_processes, process_id,
                                timeout_s)
+    enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator, num_processes=num_processes,
@@ -89,6 +90,44 @@ def init_distributed(coordinator: Optional[str] = None,
             "VPROXY_TPU_DIST_TIMEOUT_S for genuinely slow fleets."
         ) from e
     return True
+
+
+def cpu_collectives_available() -> bool:
+    """Can THIS jaxlib run multiprocess collectives on the CPU backend?
+    Without a cross-process CPU collectives implementation (gloo/mpi)
+    the CPU client fails any multiprocess computation with
+    "Multiprocess computations aren't implemented on the CPU backend" —
+    the capability probe tests gate on (tests/test_multihost.py) instead
+    of failing in environments that cannot comply."""
+    try:
+        from jax._src.lib import xla_extension as _xe
+        if not hasattr(_xe, "make_gloo_tcp_collectives"):
+            return False
+        # the config option wires gloo into the CPU client at creation;
+        # a jax too old to register the option cannot enable it (the
+        # option is holder-registered, not an attribute on jax.config)
+        holders = getattr(jax.config, "_value_holders", {})
+        return "jax_cpu_collectives_implementation" in holders
+    except Exception:
+        return False
+
+
+def enable_cpu_collectives() -> None:
+    """Select the gloo CPU collectives implementation (when this jaxlib
+    ships it) BEFORE the backend initializes — multiprocess CPU fleets
+    (and the 2-process tests) need it; accelerator backends ignore it.
+    Must run before the first device use; init_distributed() calls it
+    ahead of jax.distributed.initialize."""
+    if not cpu_collectives_available():
+        return
+    try:
+        holders = getattr(jax.config, "_value_holders", {})
+        cur = holders["jax_cpu_collectives_implementation"].value
+        if cur in (None, "", "none"):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:
+        pass  # backend already initialized: leave the config alone
 
 
 def _preflight_coordinator(coordinator: str, num_processes: int,

@@ -77,7 +77,21 @@ SITES = (
     "cluster.step.stall",
     "switch.flowcache.stale",
     "engine.swap.stall",
+    "lane.entry.stale",
 )
+
+# fired (no args) after any arm/disarm/clear/auto-disarm edge — the
+# accept lanes subscribe so armed faults force the classic accept path
+# in C (vtl_lanes_set_punt_all) without a per-accept ctypes crossing
+on_change: list = []
+
+
+def _fire_change() -> None:
+    for cb in list(on_change):
+        try:
+            cb()
+        except Exception:
+            pass
 
 _lock = threading.Lock()
 _registry: dict[str, "Fault"] = {}
@@ -117,6 +131,7 @@ def arm(name: str, probability: float = 1.0, count: Optional[int] = None,
     with _lock:
         _registry[name] = f
         _armed = True
+    _fire_change()
 
 
 def disarm(name: str) -> bool:
@@ -125,6 +140,8 @@ def disarm(name: str) -> bool:
     with _lock:
         gone = _registry.pop(name, None) is not None
         _armed = bool(_registry)
+    if gone:
+        _fire_change()
     return gone
 
 
@@ -134,6 +151,7 @@ def clear() -> None:
     with _lock:
         _registry.clear()
         _armed = False
+    _fire_change()
 
 
 def active() -> list[dict]:
@@ -151,6 +169,15 @@ def any_armed() -> bool:
     return _armed
 
 
+def any_armed_excluding(prefix: str) -> bool:
+    """any_armed() minus sites under `prefix` — the accept lanes force
+    the classic path for every armed fault EXCEPT the lane.* sites
+    themselves (lane.entry.stale suppresses a generation bump; forcing
+    punts on it would make the gate untestable)."""
+    with _lock:
+        return any(not n.startswith(prefix) for n in _registry)
+
+
 def hit(name: str, ctx: str = "") -> bool:
     """Ask a site whether its fault fires for this event. Decrements a
     count arm on fire and auto-disarms at zero. Safe from any thread."""
@@ -166,11 +193,15 @@ def hit(name: str, ctx: str = "") -> bool:
         if f.probability < 1.0 and f._rng.random() >= f.probability:
             return False
         f.hits += 1
+        auto_disarmed = False
         if f.count is not None:
             f.count -= 1
             if f.count <= 0:
                 del _registry[name]
                 _armed = bool(_registry)
+                auto_disarmed = True
+    if auto_disarmed:
+        _fire_change()  # a count arm draining re-enables the lanes
     from . import events
     events.record("fault_injected", f"failpoint {name} fired",
                   failpoint=name, ctx=ctx)

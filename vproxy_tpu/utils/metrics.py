@@ -293,6 +293,19 @@ class GlobalInspection:
             self.registry.gauge_f("vproxy_switch_native_drop_total",
                                   lambda j=j: self._flowcache_counter(5 + j),
                                   reason=r)
+        # accept-lane counters (native/vtl.cpp accept lanes, the C
+        # accept plane): accepts taken by lanes, sessions served wholly
+        # in C, and punts by reason — classic (no entry / armed
+        # failpoints / overload), stale (generation gate), connect_fail
+        # (fed to the retry/ejection machinery). Zeros without the .so.
+        self.registry.gauge_f("vproxy_lane_accepted_total",
+                              lambda: self._lane_counter(0))
+        self.registry.gauge_f("vproxy_lane_served_total",
+                              lambda: self._lane_counter(1))
+        for j, r in enumerate(("classic", "stale", "connect_fail")):
+            self.registry.gauge_f("vproxy_lane_punt_total",
+                                  lambda j=j: self._lane_counter(2 + j),
+                                  reason=r)
         # classify-engine generation installs (rules/engine.py): total
         # published generations and the published device-table bytes
         # per matcher kind; vproxy_engine_swap_ms (install latency) is
@@ -352,6 +365,11 @@ class GlobalInspection:
     def _flowcache_counter(i: int) -> float:
         from ..net import vtl
         return float(vtl.flowcache_counters()[i])
+
+    @staticmethod
+    def _lane_counter(i: int) -> float:
+        from ..net import vtl
+        return float(vtl.lane_counters()[i])
 
     def _loop_health(self, key: str) -> float:
         with self._lock:
