@@ -23,17 +23,18 @@ import os
 import socket
 import sys
 import threading
-import time
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
 
 from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
 force_cpu(8)
 
-from vproxy_tpu.cluster import ClusterNode  # noqa: E402
-from vproxy_tpu.control.app import Application  # noqa: E402
+import _fleetlib  # noqa: E402  (tools/_fleetlib.py — shared fleet helpers)
+
 from vproxy_tpu.control.command import Command  # noqa: E402
 from vproxy_tpu.control.http_controller import HttpController  # noqa: E402
 from vproxy_tpu.rules import oracle  # noqa: E402
@@ -41,38 +42,15 @@ from vproxy_tpu.rules.ir import Hint  # noqa: E402
 
 N_RULES = 16
 
-
-def free_port(kind=socket.SOCK_DGRAM):
-    s = socket.socket(socket.AF_INET, kind)
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+boot = _fleetlib.boot_node_env  # the production env-boot path
 
 
 def wait_for(pred, timeout=15.0, what=""):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return
-        time.sleep(0.02)
-    assert pred(), f"timeout: {what}"
-
-
-def boot(i, spec):
-    """The production boot path: env vars -> ClusterNode.boot_from_env."""
-    os.environ["VPROXY_TPU_CLUSTER_PEERS"] = spec
-    os.environ["VPROXY_TPU_CLUSTER_SELF"] = str(i)
-    app = Application(workers=1)
-    app.cluster = ClusterNode.boot_from_env(app)
-    assert app.cluster is not None and app.cluster.self_id == i
-    return app, app.cluster
+    assert _fleetlib.wait_for(pred, timeout), f"timeout: {what}"
 
 
 def main() -> int:
-    spec = ",".join(
-        f"127.0.0.1:{free_port(socket.SOCK_DGRAM)}"
-        f"/{free_port(socket.SOCK_STREAM)}" for _ in range(3))
+    spec = _fleetlib.cluster_spec(3)
     # fast-converging, test-sized timers; barrier timeout BELOW the
     # membership down-detection so a kill exercises the degrade edge
     os.environ["VPROXY_TPU_CLUSTER_HB_MS"] = "0"  # module default wins

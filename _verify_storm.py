@@ -1,0 +1,223 @@
+"""Scenario drive for adaptive overload + the storm surfaces
+(docs/robustness.md) — the round-11 verify flow. Public surfaces only,
+the way an operator meets them:
+
+  1. a tcp-lb built via the command grammar with `overload adaptive`
+     and one with the default static guard; `list-detail tcp-lb` shows
+     the overload column, the HTTP controller detail carries the
+     `overload` object;
+  2. a client surge trips the controller: the ceiling drops below
+     max-sessions (watched through the surface, not internals), excess
+     clients see RSTs, `vproxy_lb_shed_total{reason="adaptive"}` moves
+     on /metrics, and NO TIME_WAIT accumulates on the LB port; after
+     the surge the ceiling recovers;
+  3. `update tcp-lb ... overload static` hot-flips the mode back and
+     max-sessions governs again (FIN shed semantics);
+  4. a half-open client against an http-splice LB is released at the
+     handshake deadline (RST) and counted
+     `vproxy_lb_shed_total{reason="halfopen"}`;
+  5. `add fault pump.abort probability 0.5 seed 9` arms a seeded coin;
+     `GET /faults` shows it; two arms with the same seed replay the
+     same hit sequence.
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_storm.py
+"""
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
+
+from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
+
+force_cpu(8)
+
+import _fleetlib  # noqa: E402
+
+from vproxy_tpu.components import overload as ov  # noqa: E402
+from vproxy_tpu.control.app import Application  # noqa: E402
+from vproxy_tpu.control.command import Command  # noqa: E402
+from vproxy_tpu.control.http_controller import HttpController  # noqa: E402
+from vproxy_tpu.utils import failpoint  # noqa: E402
+from vproxy_tpu.utils.metrics import GlobalInspection  # noqa: E402
+
+
+def _time_waits(port: int) -> int:
+    n = 0
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(path) as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    if (int(parts[1].split(":")[1], 16) == port
+                            and parts[3] == "06"):
+                        n += 1
+        except (OSError, StopIteration):
+            pass
+    return n
+
+
+def main() -> int:
+    # storm-sized controller knobs (fast ticks, low floor) so the drive
+    # finishes in seconds; restored by process exit
+    ov.FLOOR, ov.TICK_MS, ov.ACCEPT_HI_MS = 4, 50, 15.0
+    app = Application.create(workers=1)
+    backends = [_fleetlib.EchoBackend(b"%d" % i) for i in range(2)]
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    try:
+        # ---- 1. build through the command grammar, read both surfaces
+        Command.execute(app, "add upstream u0")
+        Command.execute(app, "add server-group g0 timeout 500 period "
+                        "60000 up 1 down 100")
+        for i, b in enumerate(backends):
+            Command.execute(app, f"add server b{i} to server-group g0 "
+                            f"address 127.0.0.1:{b.port} weight 10")
+        Command.execute(app, "add server-group g0 to upstream u0 weight 10")
+        assert _fleetlib.wait_for(
+            lambda: sum(1 for s in app.server_groups["g0"].servers
+                        if s.healthy) == 2), "backends never healthy"
+        Command.execute(app, "add tcp-lb lb0 address 127.0.0.1:0 "
+                        "upstream u0 max-sessions 4096 overload adaptive")
+        detail = Command.execute(app, "list-detail tcp-lb")
+        assert any("overload adaptive(ceiling=4096" in ln
+                   for ln in detail), detail
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl.bind_port}/api/v1/module/tcp-lb/lb0",
+                timeout=5) as r:
+            obj = json.loads(r.read())
+        assert obj["overload"]["mode"] == "adaptive" \
+            and obj["overload"]["ceiling"] == 4096, obj["overload"]
+        print("[1] surfaces: list-detail overload column OK, "
+              f"HTTP overload object {obj['overload']}")
+
+        # ---- 2. surge -> ceiling drops, RST sheds counted, no TIME_WAIT
+        lb = app.tcp_lbs["lb0"]
+        port = lb.bind_port
+        shed_ctr = GlobalInspection.get().get_counter(
+            "vproxy_lb_shed_total", lb="lb0", reason="adaptive")
+        payload = os.urandom(4096)
+        stop = threading.Event()
+        resets = [0]
+
+        def surge(n_threads=24):
+            def one():
+                while not stop.is_set():
+                    try:
+                        _fleetlib.one_session(port, payload, timeout=10)
+                    except (ConnectionResetError,
+                            ConnectionAbortedError):
+                        resets[0] += 1
+                    except OSError:
+                        pass
+            ts = [threading.Thread(target=one, daemon=True)
+                  for _ in range(n_threads)]
+            for t in ts:
+                t.start()
+            return ts
+
+        ts = surge()
+        tripped = _fleetlib.wait_for(
+            lambda: lb.overload_stat()["ceiling"] < 4096, 15)
+        st = lb.overload_stat()
+        assert tripped, st
+        _fleetlib.wait_for(lambda: shed_ctr.value() > 0, 10)
+        stop.set()
+        for t in ts:
+            t.join(5)
+        shed = shed_ctr.value()
+        assert shed > 0 and resets[0] > 0, (shed, resets)
+        tw = _time_waits(port)
+        assert tw == 0, f"{tw} TIME_WAITs on the LB port after RST sheds"
+        text = GlobalInspection.get().prometheus_string()
+        assert 'vproxy_lb_shed_total{lb="lb0",reason="adaptive"}' in text
+        print(f"[2] surge: ceiling {st['ceiling']} < 4096 "
+              f"(stall-ewma {st['stallEwmaMs']}ms, accept-ewma "
+              f"{st['acceptEwmaMs']}ms), {shed:.0f} RST sheds "
+              f"({resets[0]} client resets), 0 TIME_WAIT, /metrics OK")
+        recovered = _fleetlib.wait_for(
+            lambda: lb.overload_stat()["ceiling"] == 4096, 30)
+        assert recovered, lb.overload_stat()
+        print("[2] recovery: ceiling back at max-sessions after the surge")
+
+        # ---- 3. hot-flip to static
+        Command.execute(app, "update tcp-lb lb0 overload static "
+                        "max-sessions 1")
+        detail = Command.execute(app, "list-detail tcp-lb")
+        assert any("overload static(max=1)" in ln for ln in detail), detail
+        c1 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c1.settimeout(5)
+        assert c1.recv(1) in (b"0", b"1")
+        c2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c2.settimeout(5)
+        assert c2.recv(8) == b""  # FIN, the PR-2 static semantics
+        c2.close()
+        c1.close()
+        Command.execute(app, "update tcp-lb lb0 max-sessions 0")
+        print("[3] hot-flip: static mode, max-sessions governs, FIN shed")
+
+        # ---- 4. half-open vs the handshake deadline
+        import vproxy_tpu.components.tcplb as T
+        saved_hs = T.HANDSHAKE_MS
+        T.HANDSHAKE_MS = 500
+        try:
+            Command.execute(app, "add tcp-lb lbh address 127.0.0.1:0 "
+                            "upstream u0 protocol http-splice")
+            hport = app.tcp_lbs["lbh"].bind_port
+            ho_ctr = GlobalInspection.get().get_counter(
+                "vproxy_lb_shed_total", lb="lbh", reason="halfopen")
+            s = socket.create_connection(("127.0.0.1", hport), timeout=5)
+            s.settimeout(5)
+            s.sendall(b"GET / HTTP/1.1\r\nHost: never")
+            t0 = time.monotonic()
+            try:
+                released = s.recv(1) == b""
+            except ConnectionResetError:
+                released = True
+            took = time.monotonic() - t0
+            s.close()
+            assert released and took < 3.0, (released, took)
+            assert ho_ctr.value() == 1
+            print(f"[4] slowloris: half-open released in {took:.2f}s "
+                  "(deadline, not the 15-min idle timeout), counted")
+        finally:
+            T.HANDSHAKE_MS = saved_hs
+
+        # ---- 5. seeded faults through the command + HTTP surfaces
+        Command.execute(app, "add fault pump.abort probability 0.5 seed 9")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ctl.bind_port}/faults",
+                timeout=5) as r:
+            faults = json.loads(r.read())
+        assert faults and faults[0]["name"] == "pump.abort", faults
+
+        def draw():
+            out = [failpoint.hit("pump.abort") for _ in range(32)]
+            Command.execute(app, "remove fault pump.abort")
+            return out
+
+        a = draw()
+        Command.execute(app, "add fault pump.abort probability 0.5 seed 9")
+        b = draw()
+        assert a == b and any(a) and not all(a), (a, b)
+        print("[5] seeded faults: GET /faults OK, same seed -> same "
+              "hit sequence")
+        print("STORM VERIFY OK")
+        return 0
+    finally:
+        ctl.stop()
+        failpoint.clear()
+        for b in backends:
+            b.close()
+        app.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

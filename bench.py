@@ -1331,6 +1331,26 @@ def pjit_1m_section(ph, result, dl) -> None:
         ph.done(error=repr(e)[:120])
 
 
+def _wait_stage(p, name, timeout, term_grace=10):
+    """Shared stage-child lifecycle: wait, SIGTERM (the child's handler
+    runs its own cleanup), SIGKILL, abandon — ONE copy; this block used
+    to be pasted (and drift) across every stage runner."""
+    try:
+        p.wait(timeout)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"# stage {name}: timeout, SIGTERM\n")
+        p.terminate()
+        try:
+            p.wait(term_grace)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"# stage {name}: unkillable, abandoned\n")
+    _reap_child(p)
+
+
 def _run_pjit_stage(timeout):
     """The pjit-sharded stage in a forced-8-device CPU subprocess (the
     host-platform device count is frozen at backend init, so it cannot
@@ -1354,20 +1374,7 @@ def _run_pjit_stage(timeout):
     sys.stderr.flush()
     p = _run_child([sys.executable, os.path.abspath(__file__), "--child"],
                    env, here)
-    try:
-        p.wait(timeout)
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("# stage pjit: timeout, SIGTERM\n")
-        p.terminate()
-        try:
-            p.wait(20)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            try:
-                p.wait(10)
-            except subprocess.TimeoutExpired:
-                sys.stderr.write("# stage pjit: unkillable, abandoned\n")
-    _reap_child(p)
+    _wait_stage(p, "pjit", timeout, term_grace=20)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -1496,20 +1503,7 @@ def _run_host_stage(timeout):
     p = _run_child([sys.executable, os.path.join(here, "bench_host.py")],
                    env, here)
     sys.stderr.flush()
-    try:
-        p.wait(timeout)
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("# stage host: timeout, SIGTERM\n")
-        p.terminate()  # child's SIGTERM handler runs its cleanup
-        try:
-            p.wait(10)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            try:
-                p.wait(10)
-            except subprocess.TimeoutExpired:
-                sys.stderr.write("# stage host: unkillable, abandoned\n")
-    _reap_child(p)
+    _wait_stage(p, "host", timeout)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -1535,20 +1529,7 @@ def _run_switch_stage(timeout):
     p = _run_child([sys.executable, os.path.join(here, "bench_switch.py")],
                    env, here)
     sys.stderr.flush()
-    try:
-        p.wait(timeout)
-    except subprocess.TimeoutExpired:
-        sys.stderr.write("# stage switch: timeout, SIGTERM\n")
-        p.terminate()
-        try:
-            p.wait(10)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            try:
-                p.wait(10)
-            except subprocess.TimeoutExpired:
-                sys.stderr.write("# stage switch: unkillable, abandoned\n")
-    _reap_child(p)
+    _wait_stage(p, "switch", timeout)
     if os.path.exists(result_file):
         try:
             with open(result_file) as f:
@@ -1557,6 +1538,46 @@ def _run_switch_stage(timeout):
             pass
     sys.stderr.write("# stage switch: no result\n")
     return {}
+
+
+def _run_storm_stage(timeout):
+    """bench_host.py --storm in a CPU-env subprocess: the adversarial
+    scenario suite (tools/storm.py, docs/robustness.md) with its SLO
+    gates. The FULL report is the committed BENCH_r10_builder_storm.json
+    artifact; the orchestrator folds a compact per-scenario pass/fail +
+    headline-SLO snapshot into the round artifact."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_storm.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(f"# === stage storm (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--storm"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "storm", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage storm: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    out = {"storm_pass": rep.get("pass"), "storm_seed": rep.get("seed"),
+           "storm": {}}
+    for name, s in rep.get("scenarios", {}).items():
+        out["storm"][name] = {
+            "pass": s.get("pass"),
+            "slo": {k: [g.get("value"), g.get("limit"), g.get("pass")]
+                    for k, g in s.get("slo", {}).items()}}
+    fc = rep.get("scenarios", {}).get("flash_crowd", {}).get("rows", {})
+    for mode in ("static", "adaptive"):
+        if mode in fc:
+            out[f"storm_flash_{mode}_p99_ms"] = fc[mode].get("p99_ms")
+    return out
 
 
 def _note_phase(phase_file, phase, seconds, **detail):
@@ -1763,6 +1784,10 @@ def orchestrate():
     # generation-swap rows on the forced-8-device CPU mesh
     result.update(_run_pjit_stage(
         float(os.environ.get("BENCH_PJIT_TIMEOUT", "900"))))
+    publish(result)
+    # adversarial storm suite: SLO-gated pass/fail snapshot rides along
+    result.update(_run_storm_stage(
+        float(os.environ.get("BENCH_STORM_TIMEOUT", "300"))))
     publish(result)
     result["phases"] = _read_phases(phase_file)
     # complete: disarm the handler so a late SIGTERM can't emit a second

@@ -162,10 +162,35 @@ def splice_canary(elg, mb: int):
     return round(mb / secs, 1) if got[0] >= total else None
 
 
+def run_storm():
+    """`--storm`: drive the adversarial scenario suite (tools/storm.py)
+    and snapshot its SLO gates as the BENCH artifact — the orchestrator
+    commits the result (BENCH_r10_builder_storm.json) like every other
+    bench round. STORM_SEED / STORM_SCALE parameterize; the seed rides
+    the artifact so a failed gate replays exactly."""
+    sys.path.insert(0, os.path.join(HERE, "tools"))
+    import storm
+    seed = _env_int("STORM_SEED", 0)
+    scale = float(os.environ.get("STORM_SCALE", "1.0"))
+    report = storm.run_all(
+        seed=seed, scale=scale,
+        log=lambda m: print(f"[storm] {m}", file=sys.stderr))
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+    if out_path:
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        os.replace(out_path + ".tmp", out_path)
+    print(json.dumps(report))
+    return 0 if report["pass"] else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    if "--storm" in sys.argv[1:]:
+        return run_storm()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

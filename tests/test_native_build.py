@@ -33,6 +33,8 @@ REQUIRED_SYMBOLS = (
     "vtl_lanes_new", "vtl_lanes_free", "vtl_lanes_close_listeners",
     "vtl_lanes_shutdown", "vtl_lanes_port", "vtl_lanes_engine",
     "vtl_lanes_set_punt_all", "vtl_lanes_set_limit",
+    "vtl_lanes_set_shed",  # adaptive overload: C-side RST shed (r10)
+    "vtl_close_rst",       # one-call RST close for the shed path (r10)
     "vtl_lanes_set_timeout", "vtl_lanes_stat", "vtl_lanes_active",
     "vtl_lanes_errno",
     "vtl_lane_counters", "vtl_lane_gen", "vtl_lane_gen_bump",

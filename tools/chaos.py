@@ -40,10 +40,13 @@ import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from vproxy_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
 force_cpu(8)
+
+import _fleetlib  # noqa: E402  (tools/_fleetlib.py — shared fleet helpers)
 
 from vproxy_tpu.components import servergroup as SG                # noqa: E402
 from vproxy_tpu.components.elgroup import EventLoopGroup           # noqa: E402
@@ -55,95 +58,17 @@ from vproxy_tpu.utils import failpoint, lifecycle                  # noqa: E402
 from vproxy_tpu.utils.events import FlightRecorder                 # noqa: E402
 
 
-class _EchoBackend:
-    """Sends its 1-byte id, then echoes; tracks sessions served."""
-
-    def __init__(self, sid: bytes):
-        self.sid = sid
-        self.sock = socket.socket()
-        self.sock.bind(("127.0.0.1", 0))
-        self.sock.listen(128)
-        self.port = self.sock.getsockname()[1]
-        self.hits = 0
-        self.alive = True
-        threading.Thread(target=self._serve, daemon=True).start()
-
-    def _serve(self):
-        while self.alive:
-            try:
-                c, _ = self.sock.accept()
-            except OSError:
-                return
-            self.hits += 1
-            threading.Thread(target=self._conn, args=(c,),
-                             daemon=True).start()
-
-    def _conn(self, c):
-        try:
-            c.sendall(self.sid)
-            while True:
-                d = c.recv(65536)
-                if not d:
-                    break
-                c.sendall(d)
-        except OSError:
-            pass
-        finally:
-            c.close()
-
-    def close(self):
-        self.alive = False
-        try:
-            self.sock.close()
-        except OSError:
-            pass
-
-
-def _one_session(port: int, payload: bytes) -> str:
-    """One byte-verified session; returns the backend id or raises."""
-    c = socket.create_connection(("127.0.0.1", port), timeout=5)
-    c.settimeout(5)
-    try:
-        sid = c.recv(1)
-        if len(sid) != 1:
-            raise OSError("no backend id (closed early)")
-        c.sendall(payload)
-        got = b""
-        while len(got) < len(payload):
-            d = c.recv(65536)
-            if not d:
-                raise OSError(f"echo truncated at {len(got)}/{len(payload)}")
-            got += d
-        if got != payload:
-            raise OSError("echo corrupted")
-        return sid.decode()
-    finally:
-        c.close()
+# fleet/load helpers live in tools/_fleetlib.py (shared with storm.py
+# and _verify_cluster.py — no per-harness copies). The chaos floor
+# counts a shed (RST/refusal) as a failed session: nothing in this
+# scenario is SUPPOSED to shed.
+_EchoBackend = _fleetlib.EchoBackend
 
 
 def _blast(port: int, n: int, clients: int, payload: bytes):
-    """n sessions across `clients` threads -> (ok, fail, id-counts)."""
-    lock = threading.Lock()
-    stats = {"ok": 0, "fail": 0, "ids": {}}
-
-    def worker(count: int) -> None:
-        for _ in range(count):
-            try:
-                sid = _one_session(port, payload)
-                with lock:
-                    stats["ok"] += 1
-                    stats["ids"][sid] = stats["ids"].get(sid, 0) + 1
-            except OSError:
-                with lock:
-                    stats["fail"] += 1
-
-    per = max(1, n // clients)
-    ts = [threading.Thread(target=worker, args=(per,)) for _ in range(clients)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join()
-    return stats
+    st = _fleetlib.blast(port, n, clients, payload)
+    return {"ok": st["ok"], "fail": st["fail"] + st["shed"],
+            "ids": st["ids"]}
 
 
 def _classify_device_drop() -> dict:
@@ -178,11 +103,18 @@ def _classify_device_drop() -> dict:
 
 def run(clients: int = 4, requests: int = 120, payload_len: int = 4096,
         eject_base_s: float = 0.5, drain_s: float = 10.0,
-        log=lambda *_: None) -> dict:
+        seed: int = None, log=lambda *_: None) -> dict:
     """Full chaos script; returns the report dict (see test_chaos.py
-    for the asserted floor on every field)."""
-    payload = os.urandom(payload_len)
-    report: dict = {}
+    for the asserted floor on every field). `seed` pins every
+    probability failpoint arm (VPROXY_TPU_FAILPOINT_SEED) and the
+    payload bytes, and rides into the report so a failing run replays."""
+    import random as _random
+    if seed is not None:
+        os.environ["VPROXY_TPU_FAILPOINT_SEED"] = str(seed)
+        payload = bytes(_random.Random(seed).randbytes(payload_len))
+    else:
+        payload = os.urandom(payload_len)
+    report: dict = {"seed": seed}
     saved = (SG.EJECT_FAILURES, SG.EJECT_BASE_S)
     SG.EJECT_FAILURES, SG.EJECT_BASE_S = 3, eject_base_s
     failpoint.clear()
@@ -339,47 +271,23 @@ def run_cluster(n_rules: int = 24, queries_per_node: int = 120,
          fleet to a new generation and every host (survivors included)
          re-joins step dispatch on it
     """
-    from vproxy_tpu.cluster import ClusterNode, parse_peers
-    from vproxy_tpu.control.app import Application
     from vproxy_tpu.control.command import Command
     from vproxy_tpu.rules import oracle
     from vproxy_tpu.rules.ir import Hint
 
-    def free_port(kind):
-        s = socket.socket(socket.AF_INET, kind)
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
-    def wait_for(pred, timeout=15.0):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if pred():
-                return True
-            time.sleep(0.02)
-        return pred()
+    wait_for = _fleetlib.wait_for
 
     failpoint.clear()
     FlightRecorder.reset()
     report: dict = {}
-    spec = ",".join(
-        f"127.0.0.1:{free_port(socket.SOCK_DGRAM)}"   # heartbeat UDP
-        f"/{free_port(socket.SOCK_STREAM)}"            # replication TCP
-        for _ in range(3))
+    spec = _fleetlib.cluster_spec(3)  # UDP heartbeat / TCP replication
     # hb 300ms x down 3 = 900ms down-detection > 400ms barrier timeout:
     # a killed node hits the barrier-timeout degrade edge, not the
     # quiet membership eviction
     HB, POLL, STEP_TO = 300, 120, 400
 
     def mk_node(i):
-        app = Application(workers=1)
-        node = ClusterNode(app, i, parse_peers(spec), hb_ms=HB,
-                           poll_ms=POLL)
-        app.cluster = node
-        node.membership.start()
-        node.replicator.start()
-        return app, node
+        return _fleetlib.make_node(i, spec, hb_ms=HB, poll_ms=POLL)
 
     log("phase 1: convergence")
     apps, nodes = zip(*[mk_node(i) for i in range(3)])
@@ -503,6 +411,9 @@ def main(argv=None) -> int:
     ap.add_argument("--drain-s", type=float, default=10.0)
     ap.add_argument("--cluster", action="store_true",
                     help="run the cluster-plane scenario instead")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="pin failpoint RNGs + payload bytes "
+                    "(VPROXY_TPU_FAILPOINT_SEED); echoed into the report")
     args = ap.parse_args(argv)
     if args.cluster:
         report = run_cluster(
@@ -516,7 +427,7 @@ def main(argv=None) -> int:
         return 0 if floor_ok else 1
     report = run(clients=args.clients, requests=args.requests,
                  payload_len=args.payload, eject_base_s=args.eject_base,
-                 drain_s=args.drain_s,
+                 drain_s=args.drain_s, seed=args.seed,
                  log=lambda m: print(f"[chaos] {m}", file=sys.stderr))
     print(json.dumps(report, indent=2, default=str))
     floor_ok = report["success_rate"] >= 0.99

@@ -146,6 +146,12 @@ class Replicator:
         self._applying = False      # replicated replay must not re-journal
         self._force_snapshot = False
         self._last_leader: Optional[int] = None
+        # True once this node's state provably reached / came from the
+        # fleet (a successful leader sync, or journaling with UP
+        # peers): fleet-confirmed state REFUSES backward installs from
+        # stale restarted leaders (apply_frame), closing the
+        # rolling-upgrade rollback race
+        self._fleet_confirmed = False
         self._stopped = False
         self._on_generation: list = []  # cb(generation) after install
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -229,6 +235,11 @@ class Replicator:
             self.journal.append((gen, line))
             if len(self.journal) > JOURNAL_CAP:
                 del self.journal[: len(self.journal) - JOURNAL_CAP]
+        if any(p.up for p in self.membership.peer_list()
+               if p.node_id != self.membership.self_id):
+            # journaling toward a live fleet: this state is (being)
+            # replicated — it must never roll back to an empty restart
+            self._fleet_confirmed = True
         events.record("generation_bump",
                       f"rule generation {gen}: {line[:120]}",
                       generation=gen)
@@ -252,7 +263,14 @@ class Replicator:
             if req.get("t") != "sync":
                 return
             follower_gen = int(req.get("gen", 0))
-            resp = self._sync_response(follower_gen)
+            if self._fleet_ahead() is not None:
+                # serving our (stale) state while we are still catching
+                # up from the fleet would replicate the rollback a
+                # rolling leader restart exists to avoid: tell the
+                # follower to hold its last-known-good and poll again
+                resp = {"t": "behind", "gen": self.generation}
+            else:
+                resp = self._sync_response(follower_gen)
             _send_frame(conn, resp,
                         torn=failpoint.hit("cluster.replicate.torn",
                                            f"gen={resp['gen']}"))
@@ -296,6 +314,23 @@ class Replicator:
             except Exception:
                 _log.error("replication sync failed", exc=True)
 
+    def _fleet_ahead(self):
+        """(peer_id, generation) of the highest-generation UP peer
+        advertising a generation beyond ours, else None. Heartbeats
+        carry each node's generation, which is what makes a freshly
+        RESTARTED lowest-id node — leader by id, stale by state —
+        visible as behind the fleet it nominally leads (the
+        rolling-upgrade storm scenario's leader roll)."""
+        m = self.membership
+        best = None
+        for p in m.peer_list():
+            if p.node_id == m.self_id or not p.up:
+                continue
+            if p.generation > self.generation and (
+                    best is None or p.generation > best[1]):
+                best = (p.node_id, p.generation)
+        return best
+
     def sync_once(self) -> bool:
         """One follower poll against the current leader; True when a
         frame was applied cleanly (incl. noop). Callable directly by
@@ -303,7 +338,15 @@ class Replicator:
         m = self.membership
         lid = m.leader_id()
         if lid == m.self_id:
-            return True  # leading: nothing to pull
+            ahead = self._fleet_ahead()
+            if ahead is None:
+                return True  # leading with fleet-current state
+            # leader by id, stale by state (a rolling restart brought
+            # the lowest id back empty): catch up FROM the fleet before
+            # acting as its source of truth — last-known-good stays
+            # serving everywhere while this node pulls a snapshot
+            lid = ahead[0]
+            self._force_snapshot = True
         if self._last_leader is not None and self._last_leader != lid:
             # new leader: its journal numbering is not ours to trust —
             # neither is the lag baseline we accumulated from the old one
@@ -346,6 +389,25 @@ class Replicator:
         t0 = time.monotonic()
         kind = frame.get("t")
         gen = int(frame.get("gen", 0))
+        if kind == "behind":
+            # the leader itself is catching up from the fleet (fresh
+            # restart into the lowest id): keep serving last-known-good
+            # and poll again — its stale generation must not touch the
+            # lag baseline either
+            return False
+        if gen < self.generation and self._fleet_confirmed:
+            # a leader offering to move us BACKWARD while our own state
+            # is fleet-confirmed is a stale restart whose heartbeats
+            # haven't told it yet (the rolling-upgrade race): hold
+            # last-known-good — the catch-up path will pull OUR state
+            # into it. The legitimate backward install (this node
+            # journaled ALONE in its boot window, the real fleet then
+            # appeared) stays allowed: that state was never confirmed.
+            self._reject(gen, f"backward generation from leader "
+                              f"(local {self.generation} is "
+                              "fleet-confirmed; stale leader must "
+                              "catch up first)")
+            return False
         want = frame.get("cksum")
         # assignment, not max(): a legitimate backward move (the real
         # leader appearing after this node journaled alone in the boot
@@ -361,6 +423,24 @@ class Replicator:
         if kind == "incr":
             lines = list(frame.get("cmds", []))
         elif kind == "snap":
+            if self.journal and not self._fleet_confirmed:
+                # locally-journaled generations that never reached the
+                # fleet are about to be replaced by its snapshot. The
+                # mutation gate (control/command.py) refuses writes on
+                # a leader-by-id that can SEE it is behind, but
+                # membership needs heartbeats before a restarted node
+                # can see the fleet at all — a write accepted in that
+                # blind window lands here, and discarded state must be
+                # LOUD, never silent
+                lost = [ln for _, ln in self.journal]
+                _log.error(
+                    f"fleet snapshot discards {len(lost)} unconfirmed "
+                    "local generation(s): "
+                    + "; ".join(ln[:60] for ln in lost[:4]))
+                events.record(
+                    "generation_discard",
+                    f"{len(lost)} unconfirmed local generation(s) "
+                    "replaced by fleet snapshot", discarded=len(lost))
             self._teardown()
             lines = [ln for ln in frame.get("config", "").splitlines()
                      if ln.strip() and not ln.startswith("#")]
@@ -398,6 +478,7 @@ class Replicator:
             return False
         # checksum verified: atomically publish the new generation
         self.generation = gen
+        self._fleet_confirmed = True  # this state came FROM the fleet
         self._force_snapshot = False
         swap_ms = (time.monotonic() - t0) * 1e3
         events.record("generation_install",

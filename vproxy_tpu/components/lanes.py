@@ -128,7 +128,11 @@ class AcceptLanes:
             self.uring, lb.timeout_ms, lb.connect_timeout_ms)
         if lb.bind_port == 0:
             lb.bind_port = vtl.lanes_port(self.handle)
-        vtl.lanes_set_limit(self.handle, lb.max_sessions)
+        vtl.lanes_set_limit(self.handle,
+                            max(0, lb.effective_max_sessions()
+                                - lb.active_sessions))
+        if getattr(lb, "_overguard", None) is not None:
+            vtl.lanes_set_shed(self.handle, True)  # adaptive: RST in C
         lb.backend.add_listener(self._on_mutation)
         lb.security_group.add_listener(self._on_mutation)
         failpoint.on_change.append(self._on_failpoints)
@@ -204,9 +208,10 @@ class AcceptLanes:
         with self._handle_lock:
             if not self.handle:
                 return {"on": False}
-            (accepted, served, active, p_classic, p_stale, p_fail,
-             nbytes, gen, engine, port, killed) = vtl.lanes_stat(
-                 self.handle)
+            st = vtl.lanes_stat(self.handle)
+        (accepted, served, active, p_classic, p_stale, p_fail,
+         nbytes, gen, engine, port, killed) = st[:11]
+        shed = st[11] if len(st) > 11 else 0  # pre-r10 .so: no C shed
         punts = p_classic + p_stale + p_fail
         return {"on": True, "lanes": self.n,
                 "engine": "uring" if engine else "epoll",
@@ -214,7 +219,7 @@ class AcceptLanes:
                 "gen": gen, "accepted": accepted, "served": served,
                 "active": active, "punts": punts,
                 "punt_stale": p_stale, "punt_connect_fail": p_fail,
-                "killed": killed, "bytes": nbytes,
+                "killed": killed, "shed": shed, "bytes": nbytes,
                 "hit_rate": round(
                     (served + killed) / max(1, served + killed + punts),
                     4),
@@ -240,6 +245,22 @@ class AcceptLanes:
         with self._handle_lock:
             if self.handle:
                 vtl.lanes_set_limit(self.handle, n)
+
+    def set_shed(self, on: bool) -> None:
+        """Adaptive-overload RST shed inside C for over-limit accepts
+        (components/overload.py flips this with the controller mode)."""
+        with self._handle_lock:
+            if self.handle:
+                vtl.lanes_set_shed(self.handle, on)
+
+    def shed_count(self) -> int:
+        """Cumulative C-side RST sheds (the guard tick diffs this into
+        vproxy_lb_shed_total{reason=adaptive})."""
+        with self._handle_lock:
+            if not self.handle:
+                return 0
+            st = vtl.lanes_stat(self.handle)
+        return st[11] if len(st) > 11 else 0
 
     # ------------------------------------------------------------ hooks
 
@@ -387,6 +408,11 @@ class AcceptLanes:
                 try:
                     st = vtl.lanes_stat(handle)
                     acc = st[0] - st[3] - st[4]  # - classic - stale
+                    if len(st) > 11:
+                        # C RST-sheds never generate connect load and
+                        # must not fund the budget (the python shed path
+                        # returns before on_accept for the same reason)
+                        acc -= st[11]
                 except OSError:
                     acc = last_accepted
                 if acc > last_accepted:

@@ -46,6 +46,14 @@ RETRY_BUDGET_RATIO = float(os.environ.get("VPROXY_TPU_RETRY_BUDGET", "0.2"))
 MAX_SESSIONS = int(os.environ.get("VPROXY_TPU_MAX_SESSIONS", "1000000"))
 CONNECT_TIMEOUT_MS = int(os.environ.get("VPROXY_TPU_CONNECT_TIMEOUT_MS",
                                         "3000"))
+# slowloris defense (docs/robustness.md): every pre-handover phase a
+# client can stall — the TLS ClientHello peek, the http-splice head
+# parse — is bounded by this deadline instead of the (minutes-long)
+# idle timeout, so a half-open flood cannot pin fds/parser state for
+# timeout_ms per connection. Expired sessions are RST-killed and
+# counted vproxy_lb_shed_total{reason=halfopen}. 0 disables (the
+# pre-r10 behavior: the idle timeout governs).
+HANDSHAKE_MS = int(os.environ.get("VPROXY_TPU_HANDSHAKE_MS", "10000"))
 # accept-fast-lane knobs (docs/perf.md): pre-connected idle sockets per
 # (worker loop, backend) so short connections skip the backend-connect
 # round trip entirely. 0 = off (the default: pooling assumes the backend
@@ -224,6 +232,7 @@ class _SpliceBack(Handler):
         accept_stage_observe("handover", now - self.t_back)
         if self.t_acc is not None:
             accept_stage_observe("total", now - self.t_acc)
+            self.lb._observe_accept(now - self.t_acc)
 
     def _done(self, a2b: int, b2a: int, err: int) -> None:
         lb, svr = self.lb, self.target.svr
@@ -281,7 +290,7 @@ class TcpLB:
                  in_buffer_size: int = 65536, timeout_ms: int = 900_000,
                  cert_keys: Optional[list] = None,
                  max_sessions: int = 0, pool_size: int = -1,
-                 lanes: int = -1):
+                 lanes: int = -1, overload: str = ""):
         if protocol not in ("tcp", "http-splice") \
                 and processors.get(protocol) is None:
             raise ValueError(f"unsupported protocol {protocol}")
@@ -307,6 +316,18 @@ class TcpLB:
         self.connect_retries = CONNECT_RETRIES
         self.connect_timeout_ms = CONNECT_TIMEOUT_MS
         self.draining = False
+        # overload mode (docs/robustness.md): static = the PR-2 fixed
+        # ceiling; adaptive attaches the AIMD controller
+        # (components/overload.py) moving an effective ceiling on loop
+        # stall + accept latency, shedding with RST in both planes
+        from .overload import MODE, AdaptiveOverload
+        mode = overload or MODE
+        if mode not in ("static", "adaptive"):
+            raise ValueError(f"overload mode {mode!r}: "
+                             "expected 'static' or 'adaptive'")
+        self.overload_mode = mode
+        self._overguard: Optional[AdaptiveOverload] = (
+            AdaptiveOverload(self) if mode == "adaptive" else None)
         # sessions mutate from every worker loop and the counter now
         # gates behavior (overload shed, drain completion): the +=/-=
         # must not lose updates to GIL interleaving
@@ -314,6 +335,7 @@ class TcpLB:
         self._retry_budget = RetryBudget()
         self._retry_ctrs: dict[str, object] = {}
         self._overload_ctr = None
+        self._shed_ctrs: dict[str, object] = {}
         # warm backend pool (accept fast lane): per-(worker loop, backend)
         # pre-connected idle sockets, lazily spawned on first use,
         # drained on backend DOWN edges (hc or passive ejection)
@@ -398,6 +420,8 @@ class TcpLB:
                 lanes = AcceptLanes(self, self.lanes_n)
                 lanes.start()  # resolves bind_port when 0
                 self.lanes = lanes
+                if self._overguard is not None:
+                    self._overguard.start()  # also flips C RST shed on
                 return
             except OSError as e:
                 _log.warn(f"tcp-lb {self.alias}: accept lanes failed "
@@ -422,11 +446,15 @@ class TcpLB:
             raise OSError(
                 f"tcp-lb {self.alias}: bind failed on "
                 f"{self.bind_ip}:{self.bind_port}: {e}") from e
+        if self._overguard is not None:
+            self._overguard.start()
 
     def stop(self) -> None:
         if not self.started:
             return
         self.started = False
+        if self._overguard is not None:
+            self._overguard.stop()
         self.acceptor.detach(self)
         if self.lanes is not None:
             self.lanes.shutdown()
@@ -468,13 +496,24 @@ class TcpLB:
     def _sessions_delta(self, d: int) -> None:
         with self._sess_lock:
             self.active_sessions += d
-            n = self.active_sessions
+        self._push_lane_limit()
+
+    def effective_max_sessions(self) -> int:
+        """The live admission ceiling: max_sessions in static mode, the
+        adaptive controller's current ceiling otherwise."""
+        g = self._overguard
+        return g.ceiling if g is not None else self.max_sessions
+
+    def _push_lane_limit(self) -> None:
+        """Forward the remaining session budget to the C lanes: the
+        ceiling (static OR the adaptive controller's moving one) is
+        SHARED across both admission planes — the C side admits only
+        the remainder, so python-held sessions (punts) can never stack
+        a second ceiling on top of the lane ones."""
         lanes = self.lanes
         if lanes is not None:
-            # the overload ceiling is SHARED: the C lanes admit only the
-            # remaining budget, so python-held sessions (punts) can
-            # never stack a second max_sessions on top of the lane ones
-            lanes.set_limit(max(0, self.max_sessions - n))
+            lanes.set_limit(max(0, self.effective_max_sessions()
+                                - self.active_sessions))
 
     def _retries_total(self, result: str):
         c = self._retry_ctrs.get(result)
@@ -490,6 +529,45 @@ class TcpLB:
             self._overload_ctr = GlobalInspection.get().get_counter(
                 "vproxy_lb_overload_total", lb=self.alias)
         return self._overload_ctr
+
+    def _shed_total(self, reason: str):
+        """vproxy_lb_shed_total{lb,reason} — reason ∈ {static, adaptive,
+        halfopen}: what WAS silent (which guard refused, and whether the
+        slowloris deadline fired) is now countable per cause."""
+        c = self._shed_ctrs.get(reason)
+        if c is None:
+            from ..utils.metrics import GlobalInspection
+            c = self._shed_ctrs[reason] = GlobalInspection.get().get_counter(
+                "vproxy_lb_shed_total", lb=self.alias, reason=reason)
+        return c
+
+    def _observe_accept(self, seconds: float) -> None:
+        g = self._overguard
+        if g is not None:
+            g.observe_accept(seconds)
+
+    def _handshake_ms(self) -> int:
+        """Pre-handover phase deadline: the module-level HANDSHAKE_MS
+        (read per call so tests/ops can retune), never beyond the idle
+        timeout; 0 disables (falls back to timeout_ms)."""
+        hs = HANDSHAKE_MS
+        return min(self.timeout_ms, hs) if hs > 0 else self.timeout_ms
+
+    def _halfopen_count(self, desc: str) -> None:
+        """One half-open release: the shed accounting shared by every
+        pre-handover deadline path (TLS hello peek, http head parse) —
+        one site, so the metric semantics cannot fork between them."""
+        self._overload_total().incr()
+        self._shed_total("halfopen").incr()
+        events.record("halfopen_shed", desc, lb=self.alias)
+
+    def _halfopen_kill(self, conn) -> None:
+        """A pre-handover phase blew the handshake deadline: RST the
+        client (no TIME_WAIT for flood sheds) and count it."""
+        vtl.set_linger0(conn.fd)
+        conn.close(errno.ETIMEDOUT)
+        self._halfopen_count(f"{conn.remote[0]}:{conn.remote[1]} shed: "
+                             "handshake deadline")
 
     # ------------------------------------------------- warm backend pool
 
@@ -722,16 +800,26 @@ class TcpLB:
             events.record("drain_shed", f"{ip}:{port} shed: draining",
                           lb=self.alias)
             return
-        if self.active_sessions + self.lane_active() >= self.max_sessions:
+        eff = self.effective_max_sessions()
+        if self.active_sessions + self.lane_active() >= eff:
             # overload guard: close-on-accept beats queueing unboundedly.
             # Lane-owned sessions count against the same budget — the C
-            # side bounds itself at max_sessions and punts past it, and
-            # this check stops those punts from doubling the ceiling.
+            # side bounds itself at the shared ceiling and punts (or
+            # RST-sheds, adaptive mode) past it, and this check stops
+            # those punts from doubling the ceiling. Adaptive sheds RST
+            # (a crowd big enough to move the ceiling would park one
+            # TIME_WAIT per FIN-shed); static keeps the clean close.
             self._overload_total().incr()
-            vtl.close(cfd)
+            if self._overguard is not None:
+                self._shed_total("adaptive").incr()
+                vtl.close_rst(cfd)
+            else:
+                self._shed_total("static").incr()
+                vtl.close(cfd)
             events.record(
                 "overload", f"{ip}:{port} shed: {self.active_sessions} "
-                f"sessions at max {self.max_sessions}", lb=self.alias)
+                f"sessions at ceiling {eff} (max {self.max_sessions})",
+                lb=self.alias, mode=self.overload_mode)
             return
         self.accepted += 1
         self._retry_budget.on_accept()
@@ -846,9 +934,14 @@ class TcpLB:
         # without it a post-timeout rearm could re-enable reads on a
         # RECYCLED fd number owned by an unrelated connection
         deadline: list = [None]
+        # the hello peek is a pre-handover phase: bounded by the
+        # handshake deadline (slowloris defense), not the idle timeout;
+        # with the deadline disabled (HANDSHAKE_MS=0) expiry keeps the
+        # pre-r10 plain-close semantics, not the RST + halfopen count
         deadline[0] = loop.delay(
-            self.timeout_ms,
-            lambda: self._peek_abort(loop, cfd, deadline))
+            self._handshake_ms(),
+            lambda: self._peek_abort(loop, cfd, deadline,
+                                     halfopen=HANDSHAKE_MS > 0))
 
         def on_ev(fd: int, ev: int) -> None:
             if ev & vtl.EV_ERROR:
@@ -917,7 +1010,8 @@ class TcpLB:
                 deadline[0] = None
             vtl.close(cfd)
 
-    def _peek_abort(self, loop, cfd: int, deadline=None) -> None:
+    def _peek_abort(self, loop, cfd: int, deadline=None,
+                    halfopen: bool = False) -> None:
         if deadline and deadline[0] is not None:
             deadline[0].cancel()
             deadline[0] = None
@@ -926,6 +1020,14 @@ class TcpLB:
                 loop.remove(cfd)
         except Exception:
             pass
+        if halfopen:
+            # the handshake deadline fired with the hello still
+            # incomplete: a slowloris/half-open client — RST (no
+            # TIME_WAIT for flood sheds) and count the release
+            self._halfopen_count("tls hello never completed: "
+                                 "handshake deadline")
+            vtl.close_rst(cfd)
+            return
         vtl.close(cfd)
 
     def _serve_tls_python_fallback(self, loop, cfd: int, ip: str,
@@ -1006,12 +1108,48 @@ class TcpLB:
 
     def set_max_sessions(self, n: int) -> None:
         """Hot-set the overload ceiling for BOTH admission paths: the
-        python accept check and the C lanes' active bound."""
+        python accept check and the C lanes' active bound. In adaptive
+        mode this moves the controller's UPPER bound; the effective
+        ceiling re-clamps on its next tick."""
         self.max_sessions = n if n > 0 else MAX_SESSIONS
-        lanes = self.lanes
-        if lanes is not None:
-            lanes.set_limit(max(0, self.max_sessions
-                                - self.active_sessions))
+        g = self._overguard
+        if g is not None:
+            g.ceiling = min(max(g.ceiling, g.floor), self.max_sessions)
+        self._push_lane_limit()
+
+    def set_overload_mode(self, mode: str) -> None:
+        """Hot-flip static <-> adaptive (`update tcp-lb ... overload`).
+        Leaving adaptive restores the full max_sessions bound (and the
+        lanes' punt-style shed); entering it starts the controller at
+        the current ceiling."""
+        if mode not in ("static", "adaptive"):
+            raise ValueError(f"overload mode {mode!r}: "
+                             "expected 'static' or 'adaptive'")
+        if mode == self.overload_mode:
+            return
+        from .overload import AdaptiveOverload
+        if mode == "adaptive":
+            self._overguard = AdaptiveOverload(self)
+            if self.started:
+                self._overguard.start()
+        else:
+            g, self._overguard = self._overguard, None
+            if g is not None:
+                g.stop()  # also flips the C lanes' RST shed off
+        self.overload_mode = mode
+        self._push_lane_limit()
+        events.record("overload_mode",
+                      f"lb {self.alias} overload mode -> {mode}",
+                      lb=self.alias, mode=mode)
+
+    def overload_stat(self) -> dict:
+        """list-detail / HTTP detail payload: the live admission state
+        (mode, bounds, controller EWMAs when adaptive)."""
+        g = self._overguard
+        if g is None:
+            return {"mode": "static", "maxSessions": self.max_sessions,
+                    "ceiling": self.max_sessions}
+        return g.stat()
 
     def set_timeout(self, timeout_ms: int) -> None:
         """Hot-set the idle timeout AND re-arm the per-loop idle sweeps:
@@ -1090,11 +1228,24 @@ class TcpLB:
         except OSError:
             vtl.close(cfd)
             return
-        # a client that never completes its head is dropped at the timeout
+        # a client that never completes its head is a half-open
+        # (slowloris) session: dropped at the HANDSHAKE deadline — not
+        # the minutes-long idle timeout — with an RST, and counted, so
+        # a flood can neither pin parser state nor stack TIME_WAITs.
+        # The deadline bounds the CLIENT's phase only: it is cancelled
+        # the moment the head completes, so a slow classify/backend
+        # connect (bounded by its own timeouts) can never get a
+        # well-behaved client RST-killed as "halfopen"
+        head_deadline: list = [None]
+
         def head_timeout() -> None:
+            head_deadline[0] = None
             if not front.closed and not front.detached:
-                front.close()
-        loop.delay(lb.timeout_ms, head_timeout)
+                if HANDSHAKE_MS > 0:
+                    lb._halfopen_kill(front)
+                else:  # deadline disabled: the pre-r10 idle-expiry close
+                    front.close()
+        head_deadline[0] = loop.delay(lb._handshake_ms(), head_timeout)
 
         class Front(Handler):
             def on_data(self, conn: Connection, data: bytes) -> None:
@@ -1103,6 +1254,9 @@ class TcpLB:
                     conn.close()
                     return
                 if parser.done:
+                    if head_deadline[0] is not None:
+                        head_deadline[0].cancel()
+                        head_deadline[0] = None
                     conn.pause_reading()
                     hint = parser.hint()
 
@@ -1242,6 +1396,7 @@ class TcpLB:
             if t_acc is not None:
                 accept_stage_observe(
                     "total", (t_reg - t_acc) + connect_us / 1e6)
+                lb._observe_accept((t_reg - t_acc) + connect_us / 1e6)
             lb.bytes_in += a2b
             lb.bytes_out += b2a
             svr.bytes_in += a2b

@@ -99,6 +99,8 @@ PARAM_KEYS = {
     "max-sessions": "max-sessions",
     "pool-size": "pool-size",
     "lanes": "lanes",
+    "overload": "overload",
+    "seed": "seed",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -206,12 +208,26 @@ class Command:
             replicated = c.type in REPLICATED_TYPES
         if replicated:
             repl = cluster.replicator
-            if not repl._applying and not cluster.membership.is_leader():
-                raise CmdError(
-                    f"this node is a cluster follower; issue mutations "
-                    f"on the leader (node "
-                    f"{cluster.membership.leader_id()}) — followers "
-                    "converge via replication (docs/cluster.md)")
+            if not repl._applying:
+                if not cluster.membership.is_leader():
+                    raise CmdError(
+                        f"this node is a cluster follower; issue "
+                        f"mutations on the leader (node "
+                        f"{cluster.membership.leader_id()}) — followers "
+                        "converge via replication (docs/cluster.md)")
+                behind = repl._fleet_ahead()
+                if behind is not None:
+                    # leader by id, stale by state (a rolling restart
+                    # brought the lowest id back behind the fleet):
+                    # accepting a mutation here would journal it into a
+                    # generation the catch-up snapshot is about to wipe
+                    # — acknowledged, then silently lost. Refuse until
+                    # the catch-up sync converges.
+                    raise CmdError(
+                        f"this node leads by id but is behind the "
+                        f"fleet (peer {behind[0]} at generation "
+                        f"{behind[1]}, local {repl.generation}); "
+                        "catching up — retry once converged")
             with repl.mutation_lock:
                 result = handler(app, c)
                 cluster.on_command(line)
@@ -570,6 +586,9 @@ def _h_tl(app: Application, c: Command):
         if "ck" in c.params:
             cks = [_need(app.cert_keys, a, "cert-key")
                    for a in c.params["ck"].split(",")]
+        if c.params.get("overload", "") not in ("", "static", "adaptive"):
+            raise CmdError(f"overload {c.params['overload']!r}: "
+                           "expected static or adaptive")
         lb = TcpLB(c.alias, aelg, elg, ip, port, ups,
                    protocol=c.params.get("protocol", "tcp"),
                    security_group=secg,
@@ -582,7 +601,8 @@ def _h_tl(app: Application, c: Command):
                    pool_size=(_nonneg_int(c, "pool-size")
                               if "pool-size" in c.params else -1),
                    lanes=(_nonneg_int(c, "lanes")
-                          if "lanes" in c.params else -1))
+                          if "lanes" in c.params else -1),
+                   overload=c.params.get("overload", ""))
         lb.start()
         app.tcp_lbs[c.alias] = lb
         return "OK"
@@ -593,7 +613,7 @@ def _h_tl(app: Application, c: Command):
                 f"bind {lb.bind_ip}:{lb.bind_port} backend {lb.backend.alias} "
                 f"in-buffer-size {lb.in_buffer_size} protocol {lb.protocol} "
                 f"security-group {lb.security_group.alias}"
-                + _lane_summary(lb)
+                + _lane_summary(lb) + _overload_summary(lb)
                 for lb in app.tcp_lbs.values()]
     if c.action == "update":
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
@@ -622,6 +642,11 @@ def _h_tl(app: Application, c: Command):
         if "pool-size" in c.params:  # hot-set the warm backend pool
             # (0 = off); existing pools drain and respawn at the new size
             lb.set_pool_size(_nonneg_int(c, "pool-size"))
+        if "overload" in c.params:  # hot-flip static <-> adaptive
+            try:
+                lb.set_overload_mode(c.params["overload"])
+            except ValueError as e:
+                raise CmdError(str(e))
         return "OK"
     if c.action in ("remove", "force-remove"):
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
@@ -643,6 +668,19 @@ def _lane_summary(lb) -> str:
     return (f" lanes on(n={st['lanes']},engine={st['engine']},"
             f"gen={st['gen']},served={st['served']},punts={st['punts']},"
             f"hit-rate={st['hit_rate']})")
+
+
+def _overload_summary(lb) -> str:
+    """`list-detail tcp-lb` overload column: the admission mode and,
+    when adaptive, the live controller state (moving ceiling + the
+    EWMAs it is steering on)."""
+    st = lb.overload_stat()
+    if st["mode"] == "static":
+        return f" overload static(max={st['maxSessions']})"
+    return (f" overload adaptive(ceiling={st['ceiling']},"
+            f"max={st['maxSessions']},floor={st['floor']},"
+            f"stall-ewma-ms={st['stallEwmaMs']},"
+            f"accept-ewma-ms={st['acceptEwmaMs']})")
 
 
 def _h_socks5(app: Application, c: Command):
@@ -1213,10 +1251,12 @@ def _h_eventlog(app: Application, c: Command):
 
 
 def _h_fault(app: Application, c: Command):
-    """`add fault <site> [probability p] [count n] [match m]` arms a
-    named failpoint (utils/failpoint — the chaos-testing injection
-    sites); `remove fault <site>` disarms; `list fault` shows armed
-    faults with hit counts (same view as `GET /faults`)."""
+    """`add fault <site> [probability p] [count n] [match m] [seed s]`
+    arms a named failpoint (utils/failpoint — the chaos-testing
+    injection sites); without an explicit seed the probability coin is
+    derived from VPROXY_TPU_FAILPOINT_SEED so storm/chaos runs replay;
+    `remove fault <site>` disarms; `list fault` shows armed faults with
+    hit counts (same view as `GET /faults`)."""
     from ..utils import failpoint
     if c.action == "add":
         try:
@@ -1224,7 +1264,8 @@ def _h_fault(app: Application, c: Command):
                 c.alias,
                 probability=float(c.params.get("probability", "1.0")),
                 count=int(c.params["count"]) if "count" in c.params else None,
-                match=c.params.get("match"))
+                match=c.params.get("match"),
+                seed=int(c.params["seed"]) if "seed" in c.params else None)
         except ValueError as e:
             raise CmdError(str(e))
         return "OK"

@@ -72,6 +72,27 @@ class DNSServer:
                                             "1000"))
         self._ans_cache: dict = {}  # key -> (expires, token, resp bytes)
         self.cache_hits = 0
+        self.drops = 0  # responses the kernel refused (EAGAIN) — counted
+
+    def _send(self, data: bytes, ip: str, port: int) -> None:
+        """One response datagram; an EAGAIN under storm load is a DROP
+        and must be counted (vproxy_udp_drop_total), never silent —
+        the client's retry is the recovery, the counter is the evidence.
+        A raised OSError is a real send failure (EBADF, ENETUNREACH…),
+        not backpressure: logged, never reclassified as a storm drop —
+        an outage must not read as benign overload on /metrics."""
+        if self._fd is None:
+            return
+        try:
+            r = vtl.sendto(self._fd, data, ip, port)
+        except OSError:
+            _log.error(f"dns response sendto {ip}:{port} failed",
+                       exc=True)
+            return
+        if r == vtl.AGAIN:
+            self.drops += 1
+            from ..utils.metrics import udp_drop_incr
+            udp_drop_incr()
 
     # ------------------------------------------------------------ control
 
@@ -177,8 +198,7 @@ class DNSServer:
             self._ans_cache[ck] = (
                 time.monotonic() + self._cache_ms / 1000.0,
                 req._cache_token, data)
-        if self._fd is not None:
-            vtl.sendto(self._fd, data, ip, port)
+        self._send(data, ip, port)
 
     def _cache_lookup(self, req: P.Packet, q) -> Optional[bytes]:
         """-> a fresh cached response (id already patched) or None."""
@@ -205,8 +225,7 @@ class DNSServer:
             hit = self._cache_lookup(req, qs[0])
             if hit is not None:
                 self.cache_hits += 1
-                if self._fd is not None:
-                    vtl.sendto(self._fd, hit, ip, port)
+                self._send(hit, ip, port)
                 return
         # continuation pipeline over the questions: each rrsets lookup
         # rides the ClassifyService queue (DNSServer.java:136's scan),
@@ -337,7 +356,6 @@ class DNSServer:
             resp.id = req.id
             resp.is_resp = True
             resp.ra = True
-            if self._fd is not None:
-                vtl.sendto(self._fd, resp.encode(), ip, port)
+            self._send(resp.encode(), ip, port)
 
         self.recursive.query(q.qname, q.qtype, on_resp)

@@ -303,6 +303,14 @@ int vtl_write(int fd, const void* buf, int len) {
 
 int vtl_close(int fd) { return close(fd) < 0 ? -errno : 0; }
 
+// RST close (SO_LINGER{1,0}): the overload-shed path — one call, no
+// python socket-object round trip per refused connection
+int vtl_close_rst(int fd) {
+  struct linger lg = {1, 0};
+  setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+  return close(fd) < 0 ? -errno : 0;
+}
+
 int vtl_shutdown_wr(int fd) { return shutdown(fd, SHUT_WR) < 0 ? -errno : 0; }
 
 int vtl_set_rcvbuf(int fd, int bytes) {
@@ -2150,9 +2158,15 @@ struct Lanes {
   std::atomic<int> timeout_ms{900000};  // hot-settable (update timeout)
   int connect_timeout_ms = 3000;
   std::vector<Lane*> lanes;
+  // adaptive overload (components/overload.py): when shed_rst is set,
+  // over-limit accepts are RST-closed (SO_LINGER{1,0}) right here in C
+  // instead of punting — a flash crowd must not buy a GIL crossing per
+  // shed connection, and FIN closes would stack one TIME_WAIT each.
+  std::atomic<int> shed_rst{0};
   std::atomic<uint64_t> accepted{0}, served{0}, active{0},
       punt_classic{0}, punt_stale{0}, punt_fail{0}, bytes{0},
-      killed{0};  // idle-expired + shutdown-aborted (NOT served)
+      killed{0},  // idle-expired + shutdown-aborted (NOT served)
+      shed{0};    // over-limit accepts RST-closed in C (shed_rst mode)
 };
 
 // process-global tallies (every LB's lanes), pump_counters idiom —
@@ -2208,6 +2222,19 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
     rt = ow->route;
   }
   uint64_t cur = ow->gen.load(std::memory_order_relaxed);
+  if ((int64_t)ow->active.load(std::memory_order_relaxed) >=
+          ow->max_active.load(std::memory_order_relaxed) &&
+      ow->shed_rst.load(std::memory_order_relaxed) &&
+      !ow->close_listeners.load(std::memory_order_relaxed)) {
+    // over the (adaptive) ceiling with RST-shed on: refuse HERE — no
+    // punt, no Python, no TIME_WAIT. Python folds the counter into
+    // vproxy_lb_shed_total{reason=adaptive} on the guard tick.
+    struct linger lg = {1, 0};
+    setsockopt(cfd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+    close(cfd);
+    ow->shed.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
   if (ow->punt_all.load(std::memory_order_relaxed) ||
       ow->close_listeners.load(std::memory_order_relaxed) || !rt ||
       rt->seq.empty() ||
@@ -2664,8 +2691,17 @@ int vtl_lanes_set_limit(void* lp, long long n) {
   return 0;
 }
 
+// adaptive-overload shed mode: on != 0 makes over-limit accepts
+// RST-close in C (counted `shed`); off restores the classic punt so
+// Python's shed path — with its drain/static accounting — decides.
+int vtl_lanes_set_shed(void* lp, int on) {
+  if (!lp) return -EINVAL;
+  ((Lanes*)lp)->shed_rst.store(on ? 1 : 0, std::memory_order_relaxed);
+  return 0;
+}
+
 // out: accepted, served, active, punt_classic, punt_stale, punt_fail,
-// bytes, gen, engine, port, killed -> 11 (this Lanes object only)
+// bytes, gen, engine, port, killed, shed -> 12 (this Lanes object only)
 int vtl_lanes_stat(void* lp, uint64_t* out) {
   Lanes* ow = (Lanes*)lp;
   if (!ow) return -EINVAL;
@@ -2680,7 +2716,8 @@ int vtl_lanes_stat(void* lp, uint64_t* out) {
   out[8] = (uint64_t)ow->engine;
   out[9] = (uint64_t)ow->port;
   out[10] = ow->killed.load(std::memory_order_relaxed);
-  return 11;
+  out[11] = ow->shed.load(std::memory_order_relaxed);
+  return 12;
 }
 
 // process-global: accepted, served, punt_classic, punt_stale, punt_fail

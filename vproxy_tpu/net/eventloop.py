@@ -125,6 +125,12 @@ class SelectorEventLoop:
         # deadline) and longest single callback since the last read
         self._health = {"slip": 0.0, "cb": 0.0}
         self._stall_s = STALL_MS / 1000.0
+        # cumulative stall evidence (seconds): callback time beyond the
+        # 1ms scheduling floor plus timer slip past 5ms. Monotonic so
+        # the adaptive overload guard (components/overload.py) can diff
+        # it per tick into a stalls-per-second rate WITHOUT racing the
+        # /metrics take_health() read-and-reset windows.
+        self.stall_total_s = 0.0
 
     def take_health(self, key: str) -> float:
         """Read-and-reset one health window (racy by design: a lost
@@ -140,6 +146,8 @@ class SelectorEventLoop:
             _guard(fn, *args)
         finally:
             dt = time.monotonic() - t0
+            if dt > 0.001:
+                self.stall_total_s += dt - 0.001
             if dt > self._health["cb"]:
                 self._health["cb"] = dt
             if dt > self._stall_s:
@@ -355,13 +363,18 @@ class SelectorEventLoop:
     def _run_timers(self) -> None:
         now = time.monotonic()
         self.now = now
-        while self._timers and self._timers[0].deadline <= now:
+        worst_slip = 0.0  # per-pass: a burst of equally-late timers is
+        while self._timers and self._timers[0].deadline <= now:  # ONE stall
             t = heapq.heappop(self._timers)
             if not t.cancelled:
                 slip = now - t.deadline
+                if slip > worst_slip:
+                    worst_slip = slip
                 if slip > self._health["slip"]:
                     self._health["slip"] = slip
                 self._timed(t.fn)
+        if worst_slip > 0.005:
+            self.stall_total_s += worst_slip - 0.005
 
     def _next_timeout_ms(self) -> int:
         while self._timers and self._timers[0].cancelled:

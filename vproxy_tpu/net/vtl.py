@@ -133,6 +133,11 @@ def _load() -> ctypes.CDLL:
         lib.vtl_uring_probe.argtypes = []
     except AttributeError:
         pass
+    try:  # adaptive-overload lane shed (absent from a prebuilt pre-r10 .so)
+        lib.vtl_lanes_set_shed.argtypes = [p, c]
+        lib.vtl_close_rst.argtypes = [c]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -275,6 +280,47 @@ def close(fd: int) -> None:
 
 def shutdown_wr(fd: int) -> None:
     LIB.vtl_shutdown_wr(fd)
+
+
+# SO_LINGER {on=1, linger=0} — precomputed: close_rst runs once per
+# refused connection during a flash crowd, exactly the path whose whole
+# point is being cheap
+import socket as _socket  # noqa: E402
+
+_LINGER0 = struct.pack("ii", 1, 0)
+
+
+def set_linger0(fd: int) -> None:
+    """Arm SO_LINGER {on, 0} WITHOUT closing: the next close — whoever
+    owns it (a Connection, the pump teardown) — sends an RST instead of
+    a FIN. Half-open-flood kills use this so slowloris sessions leave
+    no TIME_WAIT behind."""
+    try:
+        s = _socket.socket(fileno=fd)
+    except OSError:
+        return
+    try:
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_LINGER, _LINGER0)
+    except OSError:
+        pass
+    finally:
+        s.detach()  # fd ownership stays with the caller
+
+
+def close_rst(fd: int) -> None:
+    """Close with an RST (SO_LINGER {on, 0}) instead of a FIN: overload
+    sheds must not park one TIME_WAIT per refused connection — a flash
+    crowd would exhaust the table long before it exhausts the proxy.
+    One C call when the .so has it (the shed path runs once per refused
+    connection — no python socket-object round trip); the pure-python
+    fallback degrades to a plain close when the fd isn't a socket
+    (set_linger0's no-op path)."""
+    fn = getattr(LIB, "vtl_close_rst", None)
+    if fn is not None:
+        fn(fd)
+        return
+    set_linger0(fd)
+    close(fd)
 
 
 def set_rcvbuf(fd: int, nbytes: int) -> None:
@@ -735,12 +781,23 @@ def lane_install(handle: int, packed: bytes, n: int, seq: list,
 
 def lanes_stat(handle: int) -> tuple:
     """(accepted, served, active, punt_classic, punt_stale, punt_fail,
-    bytes, gen, engine, port, killed) for ONE lanes object — killed =
-    lane-initiated teardowns (idle expiry, shutdown aborts), counted
-    apart from served so hit_rate stays honest."""
-    out = (ctypes.c_uint64 * 11)()
+    bytes, gen, engine, port, killed[, shed]) for ONE lanes object —
+    killed = lane-initiated teardowns (idle expiry, shutdown aborts),
+    counted apart from served so hit_rate stays honest; shed =
+    over-limit accepts RST-closed in C (adaptive overload; absent from
+    a prebuilt pre-r10 .so, which returns 11 fields)."""
+    out = (ctypes.c_uint64 * 12)()
     n = check(LIB.vtl_lanes_stat(handle, out))
     return tuple(int(out[i]) for i in range(n))
+
+
+def lanes_set_shed(handle: int, on: bool) -> None:
+    """Adaptive-overload shed mode: over-limit accepts RST-close inside
+    the C accept plane (no punt, no TIME_WAIT). No-op on a pre-r10 .so
+    — over-limit accepts then keep punting to the python shed path."""
+    fn = getattr(LIB, "vtl_lanes_set_shed", None)
+    if fn is not None:
+        fn(handle, 1 if on else 0)
 
 
 def lane_counters() -> tuple:

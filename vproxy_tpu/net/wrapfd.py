@@ -39,7 +39,11 @@ class BlockingUdp:
         try:
             self._q.put_nowait((data, ip, port))
         except queue.Full:
-            pass  # UDP: drop under overload, like the kernel would
+            # UDP: drop under overload, like the kernel would — but
+            # COUNTED (vproxy_udp_drop_total): a storm that overruns a
+            # blocking consumer must be visible on /metrics, not silent
+            from ..utils.metrics import udp_drop_incr
+            udp_drop_incr()
 
     def send(self, data: bytes, ip: str, port: int) -> None:
         if self.closed:

@@ -42,6 +42,12 @@ Each armed fault carries three independent gates, all optional:
 * probability p in (0, 1]  — fire on a coin flip (default 1.0). The
   coin is a per-fault `random.Random(seed)` so a seeded arm replays the
   same hit sequence — "deterministic" is the design goal, not a vibe.
+  When no explicit seed is given, the seed is derived from
+  `VPROXY_TPU_FAILPOINT_SEED` (read at arm time) combined with the site
+  name: one process-level seed makes EVERY probability arm in a
+  chaos/storm run reproducible, and the harnesses (`tools/chaos.py
+  --seed`, `tools/storm.py --seed`) echo it into their report/BENCH
+  artifact so a failed SLO gate can be replayed exactly.
 * count n                  — fire at most n times, then auto-disarm.
 * match m                  — fire only when the site's context string
   (e.g. the backend "ip:port") contains m.
@@ -116,7 +122,12 @@ class Fault:
         self.count = count  # remaining fires; None = unlimited
         self.match = match
         self.hits = 0
-        self._rng = random.Random(seed if seed is not None else name)
+        if seed is None:
+            # string seeds hash by VALUE (sha512 path), not by the
+            # PYTHONHASHSEED-randomized hash — stable across processes,
+            # so --seed replays the same arm sequence everywhere
+            seed = f"{os.environ.get('VPROXY_TPU_FAILPOINT_SEED', '')}:{name}"
+        self._rng = random.Random(seed)
 
     def describe(self) -> dict:
         return {"name": self.name, "probability": self.probability,

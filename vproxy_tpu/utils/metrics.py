@@ -331,6 +331,9 @@ class GlobalInspection:
                               lambda: self._loop_health("slip"))
         self.registry.gauge_f("vproxy_loop_callback_us_max",
                               lambda: self._loop_health("cb"))
+        # silent-drop accounting (udp_drop_incr below): created eagerly
+        # so a scrape shows the zero before the first drop
+        self.get_counter("vproxy_udp_drop_total")
 
     @staticmethod
     def _classify_stat(key: str) -> float:
@@ -490,6 +493,21 @@ class GlobalInspection:
 # Local memo keeps the hot path at one dict hit; a racy double-create
 # resolves to the same metric through get_histogram's dedup.
 _ACCEPT_STAGE_HISTS: Dict[str, Histogram] = {}
+
+# UDP drops that used to be silent (docs/robustness.md): the BlockingUdp
+# facade's queue-full drop (net/wrapfd.py) and a DNS response the kernel
+# refused with EAGAIN under storm load (dns/server.py). One process
+# counter; memoized so the drop path costs a dict hit, and pre-created
+# at first GlobalInspection access so /metrics shows the zero.
+_UDP_DROP_CTR: Optional[Counter] = None
+
+
+def udp_drop_incr(n: int = 1) -> None:
+    global _UDP_DROP_CTR
+    if _UDP_DROP_CTR is None:
+        _UDP_DROP_CTR = GlobalInspection.get().get_counter(
+            "vproxy_udp_drop_total")
+    _UDP_DROP_CTR.incr(n)
 
 
 def accept_stage_observe(stage: str, seconds: float) -> None:
