@@ -1580,6 +1580,42 @@ def _run_storm_stage(timeout):
     return out
 
 
+def _run_maglev_stage(timeout):
+    """bench_host.py --maglev in a CPU-env subprocess: consistent-hash
+    rows (docs/perf.md maglev section). The FULL report is the committed
+    BENCH_r11_builder_maglev.json artifact; the orchestrator folds the
+    headline rows — backend-pick A/B (maglev vs wrr p99 on the accept
+    path), the lane short-connection A/B, and churn-on-resize for a
+    1-of-4 peer death vs the mod-hash baseline — into the round."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_maglev.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(f"# === stage maglev (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--maglev"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "maglev", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage maglev: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    keys = ("host_pick_wrr_p99_us", "host_pick_maglev_p99_us",
+            "host_pick_maglev_vs_wrr_p99", "host_pick_maglev_no_slower_pass",
+            "host_lanes_short_wrr_rps", "host_lanes_short_maglev_rps",
+            "host_lanes_maglev_vs_wrr", "cluster_maglev_churn_1of4",
+            "cluster_maglev_churn_pass", "cluster_modhash_churn_1of4",
+            "cluster_maglev_table_m", "cluster_maglev_error")
+    return {k: rep[k] for k in keys if k in rep}
+
+
 def _note_phase(phase_file, phase, seconds, **detail):
     """Orchestrator-side phase evidence (same stream the children write):
     backoff sleeps and abandonments become visible, dated records in the
@@ -1789,6 +1825,10 @@ def orchestrate():
     result.update(_run_storm_stage(
         float(os.environ.get("BENCH_STORM_TIMEOUT", "300"))))
     publish(result)
+    # maglev consistent-hash rows: pick A/B + churn-on-resize gates
+    result.update(_run_maglev_stage(
+        float(os.environ.get("BENCH_MAGLEV_TIMEOUT", "300"))))
+    publish(result)
     result["phases"] = _read_phases(phase_file)
     # complete: disarm the handler so a late SIGTERM can't emit a second
     # (or interleaved) headline line after this one
@@ -1810,5 +1850,9 @@ if __name__ == "__main__":
         force_cpu(8)
         os.environ["BENCH_STAGE"] = "pjit"
         sys.exit(child())
+    elif "--maglev" in sys.argv:  # manual: just the maglev stage
+        print(json.dumps(_run_maglev_stage(
+            float(os.environ.get("BENCH_MAGLEV_TIMEOUT", "300")))))
+        sys.exit(0)
     else:
         sys.exit(orchestrate())

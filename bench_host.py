@@ -184,6 +184,216 @@ def run_storm():
     return 0 if report["pass"] else 1
 
 
+def run_maglev():
+    """`--maglev`: the consistent-hash rows (ISSUE 10, docs/perf.md).
+
+    1. backend-pick A/B — the accept path's per-connection pick timed
+       for method=wrr (lock + sequence walk) vs method=source (maglev:
+       one FNV + one slot load): `host_pick_{wrr,maglev}_{p50,p99}_us`.
+       Gate: maglev no slower than wrr at p99 (x1.1 tolerance).
+    2. end-to-end lane short-connection A/B — the SAME short bench with
+       the C lane pick in wrr vs maglev mode, median of 3 interleaved
+       reps (the r09 discipline).
+    3. churn-on-resize — a LIVE 4-node membership fleet (real UDP
+       heartbeats): steer a client population, kill one peer
+       mid-traffic, wait for the DOWN edge, re-steer. The fraction of
+       clients whose peer changed is the row; ideal is the dead peer's
+       share (25%), the gate allows permutation churn + sampling noise
+       (<=28%), and the mod-hash baseline shows the ~75% reshuffle this
+       replaces.
+    """
+    import random as _random
+    import socket as _socket
+
+    result = {"stage": "maglev"}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f)
+            os.replace(out_path + ".tmp", out_path)
+
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                   ServerGroup)
+    from vproxy_tpu.net import vtl as _v
+
+    # ---- 1. backend-pick micro A/B (the accept path's pick op) ----
+    elg = EventLoopGroup("mg-bench", 1)
+    try:
+        hc = HealthCheckConfig(protocol="none", period_ms=60000)
+        picks = _env_int("HOSTBENCH_PICKS", 20000)
+        rng = _random.Random(42)
+        ips = [bytes([10, 0, rng.randrange(256), rng.randrange(256)])
+               for _ in range(4096)]
+        groups = {}
+        for method in ("wrr", "source"):
+            g = ServerGroup(f"mg-{method}", elg, hc, method=method)
+            for i in range(8):
+                g.add(f"s{i}", f"10.2.0.{i}", 2000 + i)
+            for s in g.servers:
+                s.healthy = True
+            for ip in ips:  # warm: table/sequence build + hash memo —
+                g.next(ip)  # steady state is what the accept path runs
+            groups[method] = g
+        # 3 interleaved reps, median per percentile (the r09 A/B
+        # discipline): one noisy window on this shared container must
+        # not decide either side
+        t_ns = time.perf_counter_ns
+        reps: dict = {"wrr": [], "source": []}
+        for _rep in range(3):
+            for method in ("wrr", "source"):
+                g = groups[method]
+                lat = []
+                for i in range(picks):
+                    ip = ips[i & 4095]
+                    t0 = t_ns()
+                    g.next(ip)
+                    lat.append(t_ns() - t0)
+                lat.sort()
+                reps[method].append(lat)
+        for g in groups.values():
+            g.close()
+        for method, key in (("wrr", "wrr"), ("source", "maglev")):
+            for pct, frac in (("p50", 0.5), ("p99", 0.99)):
+                vals = sorted(lat[int(len(lat) * frac)]
+                              for lat in reps[method])
+                result[f"host_pick_{key}_{pct}_us"] = round(
+                    vals[1] / 1000, 3)
+        result["host_pick_maglev_vs_wrr_p99"] = round(
+            result["host_pick_maglev_p99_us"]
+            / max(result["host_pick_wrr_p99_us"], 1e-9), 3)
+        result["host_pick_maglev_no_slower_pass"] = bool(
+            result["host_pick_maglev_vs_wrr_p99"] <= 1.10)
+        flush()
+
+        # ---- 2. end-to-end lane short A/B: C pick wrr vs maglev ----
+        if _v.lanes_supported() and _v.maglev_supported():
+            build_tool()
+            from vproxy_tpu.components import lanes as lanes_mod
+            from vproxy_tpu.components.tcplb import TcpLB
+            from vproxy_tpu.components.upstream import Upstream
+            procs = []
+            welg = EventLoopGroup("mg-w", _env_int("HOSTBENCH_WORKERS", 4))
+            saved_pick = lanes_mod.LANE_PICK
+            try:
+                backends = []
+                for _ in range(2):
+                    p, port = start_server()
+                    procs.append(p)
+                    backends.append(port)
+                hcr = HealthCheckConfig(timeout_ms=300, period_ms=200,
+                                        up=1, down=2)
+                g = ServerGroup("mg-lan-g", welg, hcr, "wrr")
+                for i, port in enumerate(backends):
+                    g.add(f"b{i}", "127.0.0.1", port, weight=1)
+                deadline = time.time() + 10
+                while time.time() < deadline and not all(
+                        s.healthy for s in g.servers):
+                    time.sleep(0.05)
+                ups = Upstream("mg-lan-u")
+                ups.add(g)
+                conns = _env_int("HOSTBENCH_CONNS", 64)
+                secs = max(3.0,
+                           float(os.environ.get("HOSTBENCH_SECS", "8")) / 2)
+                lanes_n = _env_int("HOSTBENCH_LANES", 4)
+                ab = {"wrr": [], "maglev": []}
+                for _rep in range(3):
+                    for side in ("wrr", "maglev"):
+                        lanes_mod.LANE_PICK = side
+                        lb = TcpLB(f"mg-ab-{side}-{_rep}", welg, welg,
+                                   "127.0.0.1", 0, ups, protocol="tcp",
+                                   lanes=lanes_n)
+                        lb.start()
+                        try:
+                            if lb.lanes is None:
+                                raise RuntimeError("lanes fell back")
+                            run_client(lb.bind_port, min(conns, 8), 1.0,
+                                       1, short=True)
+                            r = run_client(lb.bind_port, conns, secs, 1,
+                                           short=True)
+                            ab[side].append((r["rps"], r["errors"]))
+                            if side == "maglev":
+                                st = lb.lanes.stat()
+                                result["host_lanes_maglev_stat"] = {
+                                    "pick": st.get("pick"),
+                                    "m": (st.get("maglev") or {}).get("m"),
+                                    "served": st.get("served"),
+                                    "hit_rate": st.get("hit_rate"),
+                                    "accept_ewma_ms":
+                                        st.get("accept_ewma_ms")}
+                        finally:
+                            lb.stop()
+                med = {s: sorted(x[0] for x in ab[s])[1] for s in ab}
+                result["host_lanes_short_wrr_rps"] = med["wrr"]
+                result["host_lanes_short_maglev_rps"] = med["maglev"]
+                result["host_lanes_short_reps"] = ab
+                result["host_lanes_maglev_vs_wrr"] = round(
+                    med["maglev"] / max(1.0, med["wrr"]), 3)
+                flush()
+            finally:
+                lanes_mod.LANE_PICK = saved_pick
+                for p in procs:
+                    p.terminate()
+                welg.close()
+    finally:
+        elg.close()
+
+    # ---- 3. churn-on-resize: live 4-peer fleet, 1 death ----
+    sys.path.insert(0, os.path.join(HERE, "tools"))
+    from _fleetlib import free_port, wait_for
+
+    from vproxy_tpu.cluster.membership import Membership, parse_peers
+    ports = [free_port(_socket.SOCK_DGRAM) for _ in range(4)]
+    spec = ",".join(f"127.0.0.1:{p}" for p in ports)
+    nodes = [Membership(i, parse_peers(spec)) for i in range(4)]
+    try:
+        for n in nodes:
+            n.start()
+        if not wait_for(lambda: all(n.peers_up() == 4 for n in nodes),
+                        20):
+            result["cluster_maglev_error"] = "fleet never converged"
+        else:
+            rng = _random.Random(_env_int("HOSTBENCH_SEED", 9))
+            ips = [bytes([198, 18, rng.randrange(256),
+                          rng.randrange(256)]) for _ in range(4000)]
+            m0 = nodes[0]
+            before = {ip: m0.steer_peer(ip).node_id for ip in ips}
+            nodes[3].close()  # mid-traffic death
+            if not wait_for(lambda: m0.peers_up() == 3, 20):
+                result["cluster_maglev_error"] = "DOWN edge never fired"
+            else:
+                after = {ip: m0.steer_peer(ip).node_id for ip in ips}
+                moved = sum(1 for ip in ips if before[ip] != after[ip])
+                churn = moved / len(ips)
+                dead_share = sum(
+                    1 for ip in ips if before[ip] == 3) / len(ips)
+                result["cluster_maglev_churn_1of4"] = round(churn, 4)
+                result["cluster_maglev_dead_peer_share"] = round(
+                    dead_share, 4)
+                result["cluster_maglev_slot_remap"] = \
+                    m0.steer_status()["last_remap"]
+                result["cluster_maglev_table_m"] = m0.steer_status()["m"]
+                # ideal = the dead peer's share (~25%); the gate allows
+                # permutation churn + sampling noise on top
+                result["cluster_maglev_churn_pass"] = bool(churn <= 0.28)
+                # the before-world: a mod-N rehash moves ~3/4 of clients
+                from vproxy_tpu.rules.maglev import fnv64
+                base_moved = sum(1 for ip in ips
+                                 if fnv64(ip) % 4 != fnv64(ip) % 3)
+                result["cluster_modhash_churn_1of4"] = round(
+                    base_moved / len(ips), 4)
+    finally:
+        for n in nodes:
+            n.close()
+    flush()
+    print(json.dumps(result))
+    ok = (result.get("cluster_maglev_churn_pass", False)
+          and result.get("host_pick_maglev_no_slower_pass", False))
+    return 0 if ok else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
@@ -191,6 +401,9 @@ def main():
 
     if "--storm" in sys.argv[1:]:
         return run_storm()
+
+    if "--maglev" in sys.argv[1:]:
+        return run_maglev()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

@@ -26,6 +26,13 @@ pytestmark = pytest.mark.skipif(
     not vtl.lanes_supported(),
     reason="native provider without accept-lane symbols")
 
+# the maglev lane route is a graceful degrade (maglev_supported() false
+# -> _compile punts source-method groups like pre-r11): tests of the
+# maglev pick itself must skip, not fail, on a pre-r11 .so
+needs_maglev = pytest.mark.skipif(
+    not vtl.maglev_supported(),
+    reason="native provider without maglev lane symbols")
+
 
 @pytest.fixture(autouse=True)
 def _clean_faults():
@@ -188,17 +195,19 @@ def test_lane_connect_fail_feeds_retry_and_ejection(stack):
     assert retr.value() >= 1
 
 
-def test_lane_non_wrr_method_punts(stack):
-    """source-affinity (and wlc) balancing cannot be a static pick
-    sequence: the lane entry compiles EMPTY and every connection takes
-    the python path that owns the configured semantics."""
+@needs_maglev
+def test_lane_source_method_maglev(stack):
+    """r11: method=source compiles the Maglev table (hash_port=0 —
+    source affinity IS a consistent hash) and the lanes serve it in C;
+    every connection from one client address lands on ONE backend."""
     elg = stack["make_elg"](2)
-    srv = IdServer("A")
-    stack["servers"].append(srv)
+    srvs = [IdServer(c) for c in "ABC"]
+    stack["servers"].extend(srvs)
     g = ServerGroup("lb-src-g", elg, fast_hc(), method="source")
     stack["groups"].append(g)
-    g.add("a", "127.0.0.1", srv.port)
-    wait_healthy(g, 1)
+    for c, srv in zip("abc", srvs):
+        g.add(c, "127.0.0.1", srv.port)
+    wait_healthy(g, 3)
     ups = Upstream("lb-src-u")
     ups.add(g)
     lb = TcpLB("lb-src", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
@@ -206,9 +215,84 @@ def test_lane_non_wrr_method_punts(stack):
     stack["lbs"].append(lb)
     lb.start()
     assert lb.lanes is not None
+    _wait(lambda: lb.lanes.stat()["pick"] == "maglev")
+    ids = {tcp_get_id(lb.bind_port) for _ in range(6)}
+    # loopback clients share one source address: affinity = ONE backend
+    assert len(ids) == 1
+    # served counts at pump DONE — the last reap may lag the client close
+    assert _wait(lambda: lb.lanes.stat()["served"] >= 6)
+    st = lb.lanes.stat()
+    assert lb.accepted == 0  # all served in C, zero python accepts
+    assert st["maglev"] and st["maglev"]["m"] > 0
+    # the C pick and the python punt path agree: group.next() with the
+    # loopback source address names the same backend the lanes used
+    conn = g.next(b"\x7f\x00\x00\x01")
+    sid = {s.name: s for s in g.servers}
+    assert {chr(ord("A") + "abc".index(conn.svr.name))} == ids
+    assert sid  # sanity
+
+
+@needs_maglev
+def test_lane_maglev_gen_gate_stale_iff_suppressed(stack):
+    """The PR-8 stale-gate proof, maglev-route edition: a source-method
+    (maglev) lane entry rides the SAME one-atomic-bump invariant — a
+    backend-set mutation closes the gate synchronously unless the
+    `lane.entry.stale` failpoint suppresses exactly that one bump."""
+    elg = stack["make_elg"](2)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("lb-mgate-g", elg, fast_hc(), method="source")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("lb-mgate-u")
+    ups.add(g)
+    lb = TcpLB("lb-mgate", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=2)
+    stack["lbs"].append(lb)
+    lb.start()
+    assert lb.lanes is not None
+    _wait(lambda: lb.lanes.stat()["pick"] == "maglev")
+    assert tcp_get_id(lb.bind_port) == "A"
+
+    failpoint.arm("lane.entry.stale", count=1)
+    ups.remove(g)  # the one bump this would fire is suppressed
+    stale = [tcp_get_id(lb.bind_port) for _ in range(5)]
+    assert stale == ["A"] * 5, stale  # stale maglev route still serves
+    assert failpoint.active() == []
+
+    # control arm: an UNsuppressed mutation closes the gate before the
+    # call returns — with the upstream empty, conns punt and close
+    ups.add(g)
+    assert _wait(lambda: tcp_get_id(lb.bind_port) == "A")
+    ups.remove(g)
+    c = socket.create_connection(("127.0.0.1", lb.bind_port), timeout=5)
+    c.settimeout(5)
+    assert c.recv(16) == b""  # never served through the stale table
+    c.close()
+
+
+def test_lane_wlc_method_punts(stack):
+    """wlc least-connections needs live python-side conn counts: the
+    lane entry compiles EMPTY and every connection takes the python
+    path that owns the configured semantics."""
+    elg = stack["make_elg"](2)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("lb-wlc-g", elg, fast_hc(), method="wlc")
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("lb-wlc-u")
+    ups.add(g)
+    lb = TcpLB("lb-wlc", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=2)
+    stack["lbs"].append(lb)
+    lb.start()
+    assert lb.lanes is not None
     for _ in range(3):
         assert tcp_get_id(lb.bind_port) == "A"
-    # every one of them punted to python (source hashing preserved)
+    # every one of them punted to python (wlc semantics preserved)
     assert lb.accepted == 3
     assert lb.lanes.stat()["served"] == 0
 

@@ -40,6 +40,10 @@ REQUIRED_SYMBOLS = (
     "vtl_lane_counters", "vtl_lane_gen", "vtl_lane_gen_bump",
     "vtl_lane_install", "vtl_lane_poll", "vtl_lane_rec_size",
     "vtl_lane_punt_size", "vtl_uring_probe",
+    # maglev consistent-hash pick (r11): lane route install, the parity
+    # pick surface, and the flow-cache table attach
+    "vtl_maglev_rec_size", "vtl_maglev_pick", "vtl_lane_maglev_install",
+    "vtl_flow_maglev_install", "vtl_flow_maglev_pick",
 )
 
 
@@ -68,6 +72,8 @@ def test_native_so_rebuilds_and_exports_current_abi():
     assert int(lib.vtl_lane_punt_size()) == vtl.LANE_PUNT.size, \
         "C LanePunt layout drifted from net/vtl.py LANE_PUNT"
     assert len(vtl.lane_counters()) == 5
+    assert int(lib.vtl_maglev_rec_size()) == vtl.MAGLEV_REC.size, \
+        "C MaglevRec layout drifted from net/vtl.py MAGLEV_REC"
 
 
 def test_uring_probe_contract():

@@ -131,6 +131,51 @@ def test_controller_raise_needs_sustained_calm():
     assert g.ceiling > 8
 
 
+def test_controller_trips_on_lane_latency_alone():
+    """The r11 lane-aware signal: the C accept plane serves whole
+    sessions without ever calling observe_accept, so its accept EWMA
+    (lanes_stat field 12, Lanes.accept_latency_ms) must reach the
+    controller on its own — a lanes-heavy LB under pressure used to
+    look IDLE to the python-side EWMA exactly when it was busiest."""
+
+    class _FakeLanes:
+        ms = 0.0
+
+        def accept_latency_ms(self):
+            return self.ms
+
+        def shed_count(self):
+            return 0
+
+        def set_limit(self, n, shed):
+            pass
+
+    lb = _FakeLB(max_sessions=512)
+    lanes = _FakeLanes()
+    lb.lanes = lanes
+    g = ov.AdaptiveOverload(lb, floor=4, tick_ms=50, stall_hi_ms=50.0,
+                            accept_hi_ms=25.0, alpha=0.5)
+    lb.active_sessions = 64
+    now = time.monotonic()
+    # zero python-side accepts, hot C plane -> the controller must trip
+    lanes.ms = 120.0
+    for _ in range(20):
+        now += 0.05
+        g.tick_once(now)
+    assert g.ceiling == 4, g.stat()
+    assert g.accept_ewma_ms > 25.0
+    assert g.stat()["laneAcceptEwmaMs"] == 120.0
+    # C plane cools -> sustained calm raises again (no stale-high memory)
+    lanes.ms = 0.0
+    lb.active_sessions = 2
+    for _ in range(300):
+        now += 0.05
+        g.tick_once(now)
+        if g.ceiling == 512:
+            break
+    assert g.ceiling == 512, g.stat()
+
+
 def test_ceiling_never_starts_above_max_sessions():
     """An LB whose max_sessions sits BELOW the controller floor must not
     admit past its configured maximum in the window before the first
@@ -383,8 +428,12 @@ def test_adaptive_limit_and_shed_forwarded_to_lanes(stack):
     # ...so the C plane RST-sheds the rest without punting to Python
     resets = 0
     for _ in range(8):
-        c = socket.create_connection(("127.0.0.1", lb.bind_port),
-                                     timeout=5)
+        try:
+            c = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                         timeout=5)
+        except ConnectionResetError:
+            resets += 1  # the shed RST raced the handshake itself
+            continue
         c.settimeout(5)
         try:
             if c.recv(4) == b"":

@@ -116,6 +116,7 @@ class ClusterNode:
              "leader": self.membership.leader_id(),
              "is_leader": self.membership.is_leader(),
              "service": f"{cluster_service_name()}.vproxy.local",
+             "steering": self.membership.steer_status(),
              "peers": [p.describe() for p in self.membership.peer_list()]}
         d.update(self.replicator.status())
         d["step"] = None if self.submit is None else self.submit.status()
@@ -152,10 +153,16 @@ class ClusterNode:
         return node
 
 
-def dns_peer_addrs() -> Optional[list]:
+def dns_peer_addrs(client_ip: Optional[bytes] = None) -> Optional[list]:
     """Healthy peer addresses for the cluster service name, or None when
-    no cluster is booted (dns/server.py falls through)."""
+    no cluster is booted (dns/server.py falls through). With a client
+    address the answer is Maglev-STEERED: the picked peer rides first
+    (clients use the first A record), so a peer join/death mid-traffic
+    moves only ~1/N of client affinities instead of reshuffling the
+    whole fleet (membership.steer_addrs; docs/cluster.md)."""
     node = ClusterNode._instance
     if node is None:
         return None
+    if client_ip is not None:
+        return node.membership.steer_addrs(client_ip)
     return node.membership.dns_addrs()

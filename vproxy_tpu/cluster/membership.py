@@ -149,12 +149,25 @@ class Membership:
             me.port = self._sock.getsockname()[1]
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        # maglev steering table over the UP peer set (rules/maglev.py):
+        # (up-id-key, live peers, table, names, last remap fraction).
+        # Rebuilt on peer edges — on the membership thread, never a
+        # serving one (the DNS steerer only reads the published tuple).
+        # The build lock keeps a reader that races a peer edge from
+        # publishing a table built against the pre-edge live set over
+        # the membership thread's fresh one
+        self._maglev: Optional[tuple] = None
+        self._maglev_lock = threading.Lock()
 
     # ------------------------------------------------------------- control
 
     def start(self) -> None:
         if self._thread is not None:
             return
+        try:
+            self._maglev_table()  # pre-build: first steer never pays it
+        except Exception:
+            _log.error("maglev steering prebuild failed", exc=True)
         self._thread = threading.Thread(target=self._run,
                                         name="cluster-membership",
                                         daemon=True)
@@ -233,6 +246,94 @@ class Membership:
         with self._lock:
             return max((p.generation for p in self.peers.values()),
                        default=0)
+
+    # ------------------------------------------------- maglev steering
+
+    def _maglev_table(self) -> tuple:
+        """The steering table over the CURRENT up set — rebuilt only
+        when the up-id set changed (one atomic tuple publish; readers
+        on serving threads never pay the build). Peer identity is
+        id:ip:port, so a peer keeps its permutation — and its clients —
+        across everyone else's churn."""
+        live = sorted(self.live_peers(), key=lambda p: p.node_id)
+        key = tuple(p.node_id for p in live)
+        cur = self._maglev
+        if cur is not None and cur[0] == key:
+            return cur
+        with self._maglev_lock:
+            # re-derive INSIDE the lock: a concurrent builder may have
+            # published while this one waited, and the up set may have
+            # moved again — building from the pre-lock snapshot could
+            # publish a dead peer over the fresh table
+            live = sorted(self.live_peers(), key=lambda p: p.node_id)
+            key = tuple(p.node_id for p in live)
+            cur = self._maglev
+            if cur is not None and cur[0] == key:
+                return cur
+            return self._maglev_build(live, key, cur)
+
+    def _maglev_build(self, live, key, cur) -> tuple:
+        from ..rules import maglev as MG
+        m = int(os.environ.get("VPROXY_TPU_CLUSTER_MAGLEV_M", "0")) \
+            or MG.DEFAULT_M
+        names = [f"{p.node_id}:{p.ip}:{p.port}" for p in live]
+        tab = MG.build_table([(n, 1) for n in names], m)
+        remap = MG.remap_fraction(
+            cur[2] if cur else None, tab,
+            cur[3] if cur else None, names) if cur else 0.0
+        built = (key, live, tab, names, remap)
+        self._maglev = built
+        from ..utils import events
+        if cur is not None:
+            events.record(
+                "cluster_steer_rebuild",
+                f"peer steering table rebuilt over {len(live)} UP peers: "
+                f"{remap:.1%} of client affinities moved",
+                peers=len(live), remap=round(remap, 4))
+        return built
+
+    def steer_addrs(self, client_ip: bytes) -> list[bytes]:
+        """UP peer addresses with the Maglev-picked owner FIRST (DNS
+        clients use the first A record; the rest ride along as
+        fallback). One FNV over the client address + one slot load —
+        and a peer join/death moves only ~1/N of client affinities,
+        where the old id-ordered answer pinned every client to the
+        lowest id and a resize reshuffled arbitrarily. Never empty
+        (this node is always in its own up set)."""
+        from ..rules import maglev as MG
+        from ..utils.ip import parse_ip
+        _key, live, tab, _names, _remap = self._maglev_table()
+        addrs = []
+        for p in live:
+            try:
+                addrs.append(parse_ip(p.ip))
+            except (OSError, ValueError):
+                addrs.append(None)  # hold index alignment with the table
+        i = MG.pick(tab, client_ip)  # source affinity: address only
+        out = []
+        if 0 <= i < len(addrs) and addrs[i] is not None:
+            out.append(addrs[i])
+        out.extend(a for j, a in enumerate(addrs)
+                   if a is not None and j != i)
+        if not out:
+            out.append(parse_ip(self.peers[self.self_id].ip))
+        return out
+
+    def steer_peer(self, key: bytes) -> Optional[Peer]:
+        """Maglev-consistent UP peer for an arbitrary steering key (the
+        cluster plane's generic client-steering primitive)."""
+        from ..rules import maglev as MG
+        _k, live, tab, _n, _r = self._maglev_table()
+        i = MG.pick(tab, key)
+        return live[i] if 0 <= i < len(live) else None
+
+    def steer_status(self) -> dict:
+        """GET /cluster: the steering table's shape + last-resize churn."""
+        cur = self._maglev
+        if cur is None:
+            return {"built": False}
+        return {"built": True, "m": int(len(cur[2])), "peers": len(cur[1]),
+                "last_remap": round(cur[4], 4)}
 
     # ---------------------------------------------------------- main loop
 
@@ -376,6 +477,14 @@ class Membership:
                       node=peer.node_id, generation=peer.generation)
         _log.info(f"cluster node {peer.node_id} "
                   + ("UP" if up else "DOWN"))
+        try:
+            # rebuild the steering table FIRST, before the listeners (a
+            # replicator callback can block on I/O): a DNS query racing
+            # that window would otherwise see the stale up-set key and
+            # pay the full 65537-slot build on its serving loop
+            self._maglev_table()
+        except Exception:
+            _log.error("maglev steering rebuild failed", exc=True)
         for cb in list(self._listeners):
             try:
                 cb(peer, up)

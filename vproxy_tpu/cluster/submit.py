@@ -159,7 +159,22 @@ class StepLoop:
                 "degraded": self.degraded, "steps_total": self.steps_total,
                 "barrier_stalls": self.barrier_stalls,
                 "queued": len(self._q), "batch_cap": self.batch_cap,
-                "step_ms": self.step_ms, "timeout_ms": self.timeout_ms}
+                "step_ms": self.step_ms, "timeout_ms": self.timeout_ms,
+                # client steering rides the membership maglev table
+                # (steer_addrs): epoch switches never move affinities,
+                # only UP-set changes do — surfaced here so the step
+                # view shows what a resize will cost
+                "steer": (None if self.membership is None
+                          else self.membership.steer_status())}
+
+    def steer_peer(self, key: bytes):
+        """Maglev-consistent UP-peer pick for a client steering key —
+        the submit plane's replacement for rotation when external
+        clients choose which fleet node to submit through (the DNS
+        steerer is the server-side form of the same table)."""
+        if self.membership is None:
+            return None
+        return self.membership.steer_peer(key)
 
     # ------------------------------------------------------------- barrier
 

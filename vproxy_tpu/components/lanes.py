@@ -54,6 +54,12 @@ _log = Logger("accept-lanes")
 
 LANES = int(os.environ.get("VPROXY_TPU_ACCEPT_LANES", "0"))
 LANES_URING = os.environ.get("VPROXY_TPU_ACCEPT_LANES_URING", "1") != "0"
+# backend-pick mode for wrr-method upstreams: "wrr" (default — the
+# configured round-robin semantics) or "maglev" (consistent hashing:
+# per-connection spread via the 5-tuple hash, resize moves ~1/N of
+# flows; the bench A/B lever). method=source groups ALWAYS compile the
+# maglev table — that IS their semantic (docs/perf.md).
+LANE_PICK = os.environ.get("VPROXY_TPU_LANE_PICK", "wrr")
 _SEQ_CAP = 4096  # WRR sequence bound (weights renormalized past it)
 
 
@@ -110,6 +116,12 @@ class AcceptLanes:
         self._stop = False
         self._groups: set = set()  # groups holding our on_change hook
         self._hook_lock = threading.Lock()
+        # pick-structure state for the detail surface (compiler thread
+        # writes, readers tolerate a torn mid-compile view)
+        self.pick_mode = "empty"      # "wrr" | "maglev" | "empty"
+        self.maglev_m = 0
+        self.maglev_last_remap = 0.0
+        self._maglev_prev = None      # (table, names) of the last compile
         # serializes vtl_lanes_free against cross-thread stat()/active()
         # readers (list-detail, HTTP detail, drain polling): the C
         # object must not be freed mid-read
@@ -212,6 +224,7 @@ class AcceptLanes:
         (accepted, served, active, p_classic, p_stale, p_fail,
          nbytes, gen, engine, port, killed) = st[:11]
         shed = st[11] if len(st) > 11 else 0  # pre-r10 .so: no C shed
+        lat_us = st[12] if len(st) > 12 else 0  # pre-r11 .so: no EWMA
         punts = p_classic + p_stale + p_fail
         return {"on": True, "lanes": self.n,
                 "engine": "uring" if engine else "epoll",
@@ -223,6 +236,11 @@ class AcceptLanes:
                 "hit_rate": round(
                     (served + killed) / max(1, served + killed + punts),
                     4),
+                "accept_ewma_ms": round(lat_us / 1000.0, 3),
+                "pick": self.pick_mode,
+                "maglev": ({"m": self.maglev_m,
+                            "last_remap": round(self.maglev_last_remap, 4)}
+                           if self.pick_mode == "maglev" else None),
                 "port": port}
 
     def active(self) -> int:
@@ -261,6 +279,17 @@ class AcceptLanes:
                 return 0
             st = vtl.lanes_stat(self.handle)
         return st[11] if len(st) > 11 else 0
+
+    def accept_latency_ms(self) -> float:
+        """The C-plane accept->backend-connected EWMA (ms) — the signal
+        the adaptive overload controller folds in so lane-served load
+        is no longer invisible to its accept-latency input (pre-r11 the
+        python EWMA only ever saw punts). 0.0 on a pre-r11 .so."""
+        with self._handle_lock:
+            if not self.handle:
+                return 0.0
+            st = vtl.lanes_stat(self.handle)
+        return (st[12] / 1000.0) if len(st) > 12 else 0.0
 
     # ------------------------------------------------------------ hooks
 
@@ -302,28 +331,36 @@ class AcceptLanes:
                 _log.alert(f"lanes {self.lb.alias}: compile failed: {e!r}")
 
     def _compile_install(self) -> None:
-        """Snapshot -> LANE_RECs + WRR seq -> vtl_lane_install, retried
+        """Snapshot -> LANE_RECs + pick structure (WRR seq or maglev
+        table) -> vtl_lane_install / vtl_lane_maglev_install, retried
         while mutations race the compile (bounded; the gate keeps
         correctness either way — worst case the entry stays empty and
         every accept punts)."""
         lb = self.lb
         for _ in range(8):
             gen = vtl.lane_gen(self.handle)
-            recs, seq = self._compile()
-            r = vtl.lane_install(self.handle, b"".join(recs), len(recs),
-                                 seq, gen)
+            mode, recs, aux, hash_port = self._compile()
+            if mode == "maglev":
+                r = vtl.lane_maglev_install(self.handle, b"".join(recs),
+                                            len(recs), aux, hash_port, gen)
+            else:
+                r = vtl.lane_install(self.handle, b"".join(recs),
+                                     len(recs), aux, gen)
             if r >= 0:
+                self.pick_mode = mode if recs else "empty"
                 return
             # -EAGAIN: a bump landed mid-compile; go again vs new state
         _log.warn(f"lanes {lb.alias}: install kept racing mutations; "
                   "entry left stale-gated (all accepts punt)")
 
     def _compile(self):
-        """Flatten the upstream into (backend, combined-weight) records.
-        Non-trivial ACLs and TLS holders compile to an EMPTY entry —
-        every accept punts to the python path that owns those checks.
-        Also (re)subscribes group change hooks for the current group
-        set."""
+        """Flatten the upstream into (backend, combined-weight) records
+        plus the pick structure. -> (mode, recs, seq_or_table,
+        hash_port): mode "wrr" installs the subtract-sum sequence,
+        "maglev" the consistent-hash slot table. Non-trivial ACLs and
+        TLS holders compile to an EMPTY entry — every accept punts to
+        the python path that owns those checks. Also (re)subscribes
+        group change hooks for the current group set."""
         lb = self.lb
         handles = list(lb.backend.handles)
         groups = {gh.group for gh in handles}
@@ -335,13 +372,30 @@ class AcceptLanes:
             self._groups = groups
         if (lb.holder is not None or lb.draining
                 or not lb.security_group.trivial_allow(Proto.TCP)):
-            return [], []
-        # non-wrr balancing (source affinity, wlc least-connections)
-        # cannot be expressed as a static pick sequence: compile EMPTY —
-        # every accept punts and the python path keeps the configured
-        # semantics (the same rule as non-trivial ACLs)
-        if any(gh.group.method != "wrr" for gh in handles):
-            return [], []
+            return "wrr", [], [], True
+        methods = {gh.group.method for gh in handles}
+        if "wlc" in methods:
+            # least-connections needs live python-side conn counts:
+            # compile EMPTY, python keeps the semantics
+            return "wrr", [], [], True
+        if "source" in methods:
+            weighted = [gh for gh in handles
+                        if gh.weight > 0 and gh.group.method == "source"]
+            if (methods != {"source"} or len(weighted) != 1
+                    or not vtl.maglev_supported()):
+                # mixed methods / multi-group source keep the python
+                # path's two-level semantics; an old .so without the
+                # maglev ABI punts too (never guess in C)
+                return "wrr", [], [], True
+            # source affinity IS a maglev table (hash_port=0: one
+            # backend per client address). The SAME identities, weights
+            # and M as ServerGroup._maglev_state, so the C pick and the
+            # python punt-path pick agree at every generation —
+            # tests/test_maglev.py proves it.
+            return self._compile_maglev([weighted[0]], hash_port=False)
+        if LANE_PICK == "maglev" and vtl.maglev_supported():
+            return self._compile_maglev(
+                [gh for gh in handles if gh.weight > 0], hash_port=True)
         # two-level pick, exactly like the classic path (group-level
         # WRR, then THAT group's own server WRR): flattening
         # gh.weight*s.weight would skew multi-group proportions by
@@ -364,7 +418,7 @@ class AcceptLanes:
                 group_seqs.append(
                     (gh.weight, [sidx[i] for i in _wrr_seq(sweights)]))
         if not group_seqs:
-            return recs, []
+            return "wrr", recs, [], True
         outer = _wrr_seq([w for w, _ in group_seqs])
         # close EVERY group's rotation: lcm of the inner sequence
         # lengths (max alone leaves shorter rotations mid-cycle at the
@@ -381,7 +435,58 @@ class AcceptLanes:
                 sq = group_seqs[gi][1]
                 order.append(sq[cursors[gi] % len(sq)])
                 cursors[gi] += 1
-        return recs, order
+        return "wrr", recs, order, True
+
+    def _compile_maglev(self, weighted, hash_port: bool):
+        """Compile the maglev route: MAGLEV_REC backends + the slot
+        table (rules/maglev.build_table).
+
+        Single source group (hash_port=False): the group's OWN table
+        snapshot — identical identities/weights/M to the python pick
+        path, so a punted connection routes exactly where the lane
+        would have. Multi-group wrr (hash_port=True): flattened with
+        gh.weight x s.weight scaled by the group's weight sum, so
+        group-level proportions survive regardless of server count.
+        Tracks the rebuild's slot churn for the detail surface."""
+        from ..rules import maglev as MG
+        recs, entries = [], []
+        table = None
+        if not hash_port:
+            # the group's own snapshot: build_table is deterministic on
+            # (identities, weights, M), so reusing the group's table IS
+            # the parity guarantee (and skips a redundant build)
+            g = weighted[0].group
+            servers, table = g.maglev_table()
+            for s in servers:
+                entries.append((g.maglev_identity(s), s.weight))
+                recs.append(vtl.MAGLEV_REC.pack(
+                    s.ip.encode(), s.port, 1 if ":" in s.ip else 0,
+                    min(255, s.weight)))
+        else:
+            for gh in weighted:
+                eligible = [s for s in list(gh.group.servers)
+                            if s.healthy and not s.logic_delete
+                            and s.weight > 0]
+                sw = sum(s.weight for s in eligible)
+                for s in eligible:
+                    w = max(1, round(gh.weight * s.weight * 64 / sw))
+                    entries.append(
+                        (f"{gh.group.alias}|{s.ip}:{s.port}", w))
+                    recs.append(vtl.MAGLEV_REC.pack(
+                        s.ip.encode(), s.port, 1 if ":" in s.ip else 0,
+                        min(255, s.weight)))
+        if not entries:
+            return "maglev", [], [], hash_port
+        if table is None:
+            table = MG.build_table(entries, MG.GROUP_M)
+        prev = self._maglev_prev
+        names = [n for n, _ in entries]
+        self.maglev_last_remap = MG.remap_fraction(
+            prev[0] if prev else None, table,
+            prev[1] if prev else None, names)
+        self._maglev_prev = (table, names)
+        self.maglev_m = len(table)
+        return "maglev", recs, table, hash_port
 
     # ------------------------------------------------------------ punts
 

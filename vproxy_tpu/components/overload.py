@@ -82,6 +82,7 @@ class AdaptiveOverload:
         self.ceiling = lb.max_sessions
         self.stall_ewma_ms = 0.0
         self.accept_ewma_ms = 0.0
+        self.lane_ewma_ms = 0.0  # last tick's C-plane accept EWMA
         self.ticks = 0
         self._calm_streak = 0  # raises need SUSTAINED calm (see tick)
         self._acc_lock = threading.Lock()
@@ -185,6 +186,16 @@ class AdaptiveOverload:
             s, n = self._acc_sum, self._acc_n
             self._acc_sum, self._acc_n = 0.0, 0
         acc_ms = (s / n * 1000.0) if n else 0.0
+        # lane-aware signal (r11): the C accept plane serves whole
+        # sessions without ever calling observe_accept, so a lanes-heavy
+        # LB used to look idle to this controller exactly when it was
+        # busiest. The lanes export their own accept->backend-connected
+        # EWMA (lanes_stat field 12); take the worse of the two planes
+        # as this tick's sample — one law, both admission paths.
+        lanes = getattr(lb, "lanes", None)
+        self.lane_ewma_ms = (lanes.accept_latency_ms()
+                             if lanes is not None else 0.0)
+        acc_ms = max(acc_ms, self.lane_ewma_ms)
         a = self.alpha
         self.stall_ewma_ms += a * (stall_ms - self.stall_ewma_ms)
         self.accept_ewma_ms += a * (acc_ms - self.accept_ewma_ms)
@@ -235,4 +246,5 @@ class AdaptiveOverload:
                 "ceiling": self.ceiling, "floor": self.floor,
                 "stallEwmaMs": round(self.stall_ewma_ms, 2),
                 "acceptEwmaMs": round(self.accept_ewma_ms, 2),
+                "laneAcceptEwmaMs": round(self.lane_ewma_ms, 2),
                 "ticks": self.ticks}

@@ -20,6 +20,7 @@ from typing import Callable, Optional
 
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
+from ..rules import maglev as _maglev
 from ..rules.ir import HintRule
 from ..utils import failpoint
 from .elgroup import EventLoopGroup
@@ -317,6 +318,22 @@ class ServerGroup:
         self._wrr_servers: list[ServerHandle] = []
         self._wrr_cursor = 0
         self._wrr_cache: dict[str, tuple] = {}
+        # maglev state for method=source (rules/maglev.py): table per
+        # family over the HEALTHY member set, rebuilt lazily when the
+        # health_version token moves — identity-keyed permutations mean
+        # a membership/health edge moves only the affected backend's
+        # slots, never reshuffles the group
+        self._maglev_prev: dict = {}   # cache key -> (table, names)
+        # flow_hash(ip) is pure in the address bytes, so the memo
+        # survives rebuilds (slot = h % m is re-derived per pick); it
+        # is what keeps the maglev pick at WRR cost on the accept path
+        self._maglev_hash: dict = {}   # ip bytes -> flow_hash
+        # one-slot (fam, hv, servers, tlist, m) view of _maglev_state:
+        # the pick hot path allocates NOTHING reading it (a per-call
+        # cache-key tuple doubles gen0 GC pressure vs the wrr path —
+        # that was the measured p99 tail, not the lookup itself)
+        self._maglev_fast: Optional[tuple] = None
+        self.maglev_last_remap = 0.0   # last rebuild's churn fraction
 
     # ------------------------------------------------------------- admin
 
@@ -602,6 +619,11 @@ class ServerGroup:
 
     @staticmethod
     def _sdbm(data: bytes) -> int:
+        """The reference's sdbm source hash — kept for provenance; the
+        source method now rides the Maglev table (_source_next), whose
+        consistency bound sdbm%N lacks entirely (one membership change
+        under sdbm remaps (N-1)/N of clients; Maglev moves only the
+        changed backend's share)."""
         h = 0
         for b in data:
             sb = b - 256 if b > 127 else b  # signed byte like Java
@@ -612,16 +634,98 @@ class ServerGroup:
                 h = 0
         return h
 
+    def maglev_identity(self, s: ServerHandle) -> str:
+        """The backend's stable maglev identity: the SAME string the
+        lane compiler hashes (components/lanes.py), so the C-plane pick
+        and this python pick agree bit-for-bit at a given generation."""
+        return f"{self.alias}|{s.ip}:{s.port}"
+
+    def _maglev_state(self, fam) -> dict:
+        """Per-family maglev table over the healthy, weighted, live
+        members — rebuilt when health_version moves (a dead backend's
+        slots fall to survivors; everyone else keeps their backend) and
+        dropped wholesale by _recalc's cache clear on membership
+        edits. Caller holds the group lock."""
+        key = ("maglev", fam or "all")
+        st = self._wrr_cache.get(key)
+        if st is not None and st["hv"] == self.health_version:
+            return st
+        MG = _maglev
+        servers = [s for s in self._subset(fam)
+                   if s.healthy and not s.logic_delete]
+        names = [self.maglev_identity(s) for s in servers]
+        tab = MG.build_table(list(zip(names, (s.weight for s in servers))),
+                             MG.GROUP_M)
+        prev = self._maglev_prev.get(key)
+        self.maglev_last_remap = MG.remap_fraction(
+            prev[0] if prev else None, tab,
+            prev[1] if prev else None, names)
+        self._maglev_prev[key] = (tab, names)
+        # tlist: plain-int list view of the table — numpy scalar indexing
+        # is ~5x a list load and next_source is the accept hot path
+        st = {"hv": self.health_version, "servers": servers, "table": tab,
+              "tlist": tab.tolist()}
+        self._wrr_cache[key] = st
+        return st
+
+    def maglev_info(self) -> dict:
+        """Detail-surface view (list-detail tcp-lb / HTTP detail)."""
+        if self.method != "source":
+            return {"on": False}
+        with self._lock:
+            st = self._maglev_state(None)
+        return {"on": True, "m": int(len(st["table"])),
+                "backends": len(st["servers"]),
+                "last_remap": round(self.maglev_last_remap, 4)}
+
+    def maglev_table(self, fam=None):
+        """(servers, table) snapshot for the current health generation
+        — the lane compiler and the parity tests read this."""
+        with self._lock:
+            st = self._maglev_state(fam)
+            return list(st["servers"]), st["table"]
+
     def _source_next(self, source_ip: bytes, fam,
                      exclude=None) -> Optional[Connector]:
+        """Source affinity via the Maglev table: one FNV over the client
+        address + one slot load (the table already holds only healthy
+        members, so the probe loop only runs for retry excludes). A
+        resize moves ~weight-share of clients instead of sdbm%N's
+        near-total reshuffle; the same hash/table contract as the C
+        accept lanes (tests/test_maglev.py parity)."""
         with self._lock:
-            servers = self._subset(fam)
+            fast = self._maglev_fast
+            if (fast is None or fast[0] != fam
+                    or fast[1] != self.health_version):
+                st = self._maglev_state(fam)
+                fast = self._maglev_fast = (fam, st["hv"], st["servers"],
+                                            st["tlist"], len(st["tlist"]))
+            _fam, _hv, servers, tab, m = fast
             if not servers:
                 return None
-            idx = self._sdbm(source_ip) % len(servers)
-            for _ in range(len(servers)):
-                s = servers[idx % len(servers)]
+            hc = self._maglev_hash
+            h = hc.get(source_ip)
+            if h is None:
+                if len(hc) >= 16384:  # bounded: clear beats LRU churn
+                    hc.clear()
+                h = hc[source_ip] = _maglev.flow_hash(source_ip)
+            slot = h % m
+            idx = tab[slot]
+            if idx >= 0:  # the hot path: one hash + one slot load
+                s = servers[idx]
                 if s.healthy and not (exclude and s in exclude):
                     return Connector(s, self)
-                idx += 1
+            # probe forward (retry excludes / a health edge racing the
+            # rebuild): next slots' owners, dedup'd, bounded
+            tried = {idx} if idx >= 0 else set()
+            for k in range(1, m):
+                idx = tab[(slot + k) % m]
+                if idx < 0 or idx in tried:
+                    continue
+                s = servers[idx]
+                if s.healthy and not (exclude and s in exclude):
+                    return Connector(s, self)
+                tried.add(idx)
+                if len(tried) >= len(servers):
+                    return None
             return None

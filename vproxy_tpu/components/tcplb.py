@@ -1106,6 +1106,25 @@ class TcpLB:
         in-flight client sessions invisible to active_sessions)."""
         return self.lanes.active() if self.lanes is not None else 0
 
+    def maglev_stat(self) -> dict:
+        """`list-detail tcp-lb` / HTTP detail `maglev` object: every
+        consistent-hash table this LB routes through — the C lane
+        route's (when the pick mode is maglev) and each source-method
+        group's python table — with size, generation and the last
+        resize's remap fraction (docs/perf.md)."""
+        d: dict = {"lanes": None, "groups": []}
+        lanes = self.lanes
+        if lanes is not None:
+            st = lanes.stat()
+            if st.get("on") and st.get("pick") == "maglev":
+                d["lanes"] = dict(st.get("maglev") or {}, gen=st["gen"])
+        for gh in list(self.backend.handles):
+            if gh.group.method == "source":
+                info = gh.group.maglev_info()
+                if info.get("on"):
+                    d["groups"].append(dict(info, group=gh.group.alias))
+        return d
+
     def set_max_sessions(self, n: int) -> None:
         """Hot-set the overload ceiling for BOTH admission paths: the
         python accept check and the C lanes' active bound. In adaptive

@@ -613,7 +613,8 @@ def _h_tl(app: Application, c: Command):
                 f"bind {lb.bind_ip}:{lb.bind_port} backend {lb.backend.alias} "
                 f"in-buffer-size {lb.in_buffer_size} protocol {lb.protocol} "
                 f"security-group {lb.security_group.alias}"
-                + _lane_summary(lb) + _overload_summary(lb)
+                + _lane_summary(lb) + _maglev_summary(lb)
+                + _overload_summary(lb)
                 for lb in app.tcp_lbs.values()]
     if c.action == "update":
         lb = _need(app.tcp_lbs, c.alias, "tcp-lb")
@@ -668,6 +669,24 @@ def _lane_summary(lb) -> str:
     return (f" lanes on(n={st['lanes']},engine={st['engine']},"
             f"gen={st['gen']},served={st['served']},punts={st['punts']},"
             f"hit-rate={st['hit_rate']})")
+
+
+def _maglev_summary(lb) -> str:
+    """`list-detail tcp-lb` maglev column: off, or the consistent-hash
+    tables this LB routes through (C lane route and/or source-method
+    group tables) with size, generation and last-resize remap."""
+    st = lb.maglev_stat()
+    parts = []
+    if st["lanes"] is not None:
+        ln = st["lanes"]
+        parts.append(f"lanes(m={ln.get('m')},gen={ln.get('gen')},"
+                     f"remap={ln.get('last_remap')})")
+    for g in st["groups"]:
+        parts.append(f"{g['group']}(m={g['m']},backends={g['backends']},"
+                     f"remap={g['last_remap']})")
+    if not parts:
+        return " maglev off"
+    return " maglev " + "+".join(parts)
 
 
 def _overload_summary(lb) -> str:

@@ -54,6 +54,9 @@ def _details(app, rtype: str) -> list:
             "listOfCertKey": [ck.alias for ck in lb.cert_keys],
             "lanes": (lambda _l: _l.stat() if _l is not None
                       else {"on": False})(lb.lanes),
+            # consistent-hash routing state (docs/perf.md maglev):
+            # table sizes, generations, last-resize remap fractions
+            "maglev": lb.maglev_stat(),
             # admission state (docs/robustness.md adaptive overload):
             # mode, bounds, and the controller EWMAs when adaptive
             "overload": lb.overload_stat(),

@@ -316,7 +316,15 @@ class DNSServer:
             return [parse_ip(ip)]
         from ..cluster import cluster_service_name, dns_peer_addrs
         if sub == cluster_service_name():
-            addrs = dns_peer_addrs()
+            # maglev-steered by the requester's address: the picked
+            # peer answers FIRST, so one client keeps one peer across
+            # repeat queries and a fleet resize moves only ~1/N of
+            # client affinities (cluster/membership.steer_addrs)
+            try:
+                client = parse_ip(ip)
+            except (OSError, ValueError):
+                client = None
+            addrs = dns_peer_addrs(client)
             if addrs is not None:
                 return addrs
         if sub == "who.are.you":

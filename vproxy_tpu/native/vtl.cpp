@@ -1376,6 +1376,10 @@ struct FlowCache {
   uint64_t ttl_us = 0;
   std::atomic<uint64_t> gen{0};
   uint64_t used = 0;
+  // maglev slot->candidate table (vtl_flow_maglev_install): loop-thread
+  // only, like the slot vector — the compiler installs and picks from
+  // the same thread that polls
+  std::vector<int32_t> maglev;
   // per-table probe outcomes (the globals blend every switch in the
   // process; list-detail switch wants THIS switch's hit rate)
   std::atomic<uint64_t> hits{0}, misses{0};
@@ -1754,6 +1758,102 @@ int vtl_switch_poll(void* fcp, int fd, void* buf, int slot, int maxmsgs,
   return 0;
 }
 
+// ------------------------------------------------------ maglev lookup
+//
+// Maglev consistent-hash pick (Eisenbud NSDI'16): Python compiles the
+// permutation-fill slot->backend table (rules/maglev.py) and installs
+// it C-resident; the hot-path pick is one FNV-1a 64 over the client
+// address bytes (+ port, big-endian, when per-connection spread is
+// wanted — hash_port=0 is source affinity) and one table load. The
+// SAME hash runs in rules/maglev.py and on the device gather column;
+// tests/test_maglev.py proves all three planes pick identically.
+
+static uint64_t maglev_fnv64(const uint8_t* p, size_t n) {
+  // FNV-1a 64 with the REAL offset basis 0xCBF29CE484222325 — NOT
+  // fc_hash's (that constant dropped a digit; harmless for an internal
+  // table hash, fatal for the cross-plane pick parity contract with
+  // rules/maglev.fnv64 and the device column)
+  uint64_t h = 14695981039346656037ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+static int32_t maglev_lookup(const int32_t* tab, int m, const uint8_t* ip,
+                             int iplen, int port, int hash_port) {
+  if (!tab || m <= 0 || iplen <= 0 || iplen > 16) return -1;
+  uint8_t buf[18];
+  memcpy(buf, ip, (size_t)iplen);
+  size_t n = (size_t)iplen;
+  if (hash_port) {
+    buf[n++] = (uint8_t)((port >> 8) & 0xFF);
+    buf[n++] = (uint8_t)(port & 0xFF);
+  }
+  return tab[maglev_fnv64(buf, n) % (uint64_t)m];
+}
+
+// the parity surface: tests (and any host-side caller) pick through the
+// EXACT code path the lanes use
+int vtl_maglev_pick(const int32_t* table, int m, const void* ip, int iplen,
+                    int port, int hash_port) {
+  return maglev_lookup(table, m, (const uint8_t*)ip, iplen, port,
+                       hash_port);
+}
+
+// sockaddr -> (raw addr bytes, port) for the pick; false for families
+// with no address to hash (AF_UNIX)
+static bool maglev_addr_bytes(const sockaddr_storage* ss, uint8_t* out,
+                              int* iplen, int* port) {
+  if (ss->ss_family == AF_INET) {
+    auto* a = (const sockaddr_in*)ss;
+    memcpy(out, &a->sin_addr, 4);  // network order == parse_ip bytes
+    *iplen = 4;
+    *port = ntohs(a->sin_port);
+    return true;
+  }
+  if (ss->ss_family == AF_INET6) {
+    auto* a = (const sockaddr_in6*)ss;
+    memcpy(out, &a->sin6_addr, 16);
+    *iplen = 16;
+    *port = ntohs(a->sin6_port);
+    return true;
+  }
+  return false;
+}
+
+// -------------------------------------- flow-cache maglev table attach
+//
+// The switch flow cache's consistent-rehash primitive: a flow compiler
+// can install the current generation's slot table and pick egress
+// candidates through it, so conntrack-free flows that re-miss after a
+// mutation (generation bump) rehash to the SAME destination unless the
+// destination set itself changed. Today's switch flow entries carry a
+// single resolved destination (no pick to make), so the live compiler
+// does not attach a table yet — this is the ABI the conntrack/NAT/DSR
+// roadmap item picks through (parity-tested in tests/test_maglev.py).
+// Install is generation-gated exactly like vtl_flow_install (a raced
+// bump skips the install wholesale); both calls run on the owning loop
+// thread per the flow cache's threading contract.
+
+int vtl_flow_maglev_install(void* p, const int32_t* table, int m,
+                            uint64_t gen) {
+  FlowCache* fc = (FlowCache*)p;
+  if (m < 0) return -EINVAL;
+  if (gen != fc->gen.load(std::memory_order_relaxed)) return 0;
+  fc->maglev.assign(table, table + m);
+  return m;
+}
+
+int vtl_flow_maglev_pick(void* p, const void* ip, int iplen, int port,
+                         int hash_port) {
+  FlowCache* fc = (FlowCache*)p;
+  if (fc->maglev.empty()) return -1;
+  return maglev_lookup(fc->maglev.data(), (int)fc->maglev.size(),
+                       (const uint8_t*)ip, iplen, port, hash_port);
+}
+
 // ------------------------------------------------------- io_uring engine
 //
 // The accept lanes' batched-completion engine. The ABI structs and
@@ -2105,9 +2205,16 @@ struct LanePunt {  // punt record; must match net/vtl.py LANE_PUNT
   char cip[46];
   char bip[46];
 };
+struct MaglevRec {  // maglev install record; must match net/vtl.py MAGLEV_REC
+  char ip[46];
+  uint16_t port;
+  uint8_t v6;
+  uint8_t weight;  // informational (the table already encodes weight)
+};
 #pragma pack(pop)
 static_assert(sizeof(LaneRec) == 50, "LaneRec ABI drifted");
 static_assert(sizeof(LanePunt) == 108, "LanePunt ABI drifted");
+static_assert(sizeof(MaglevRec) == 50, "MaglevRec ABI drifted");
 
 #define LANE_PUNT_CLASSIC 0
 #define LANE_PUNT_CONNECT_FAIL 1
@@ -2118,6 +2225,11 @@ struct LaneRoute {
   std::vector<sockaddr_storage> addrs;  // pre-resolved: no per-accept
   std::vector<socklen_t> lens;          // string parsing on the hot path
   std::vector<int32_t> seq;             // WRR pick sequence
+  // maglev slot->backend table (vtl_lane_maglev_install); when present
+  // it IS the pick path (seq stays empty) — one hash + one load per
+  // accept, hash_port=0 for source-affinity groups
+  std::vector<int32_t> maglev;
+  int maglev_hash_port = 1;
 };
 
 struct ConnMeta {  // per live lane pump (owning lane thread only)
@@ -2167,7 +2279,19 @@ struct Lanes {
       punt_classic{0}, punt_stale{0}, punt_fail{0}, bytes{0},
       killed{0},  // idle-expired + shutdown-aborted (NOT served)
       shed{0};    // over-limit accepts RST-closed in C (shed_rst mode)
+  // accept-latency EWMA (us): the accept->backend-connected span of
+  // lane-owned sessions, alpha 1/8 — the C-plane analog of the python
+  // accept EWMA the adaptive overload controller steers on (which was
+  // blind to lane-served traffic before r11). Relaxed read-modify-write
+  // races between lanes lose one sample, never corrupt the value.
+  std::atomic<uint64_t> lat_ewma_us{0};
 };
+
+static inline void lanes_lat_obs(Lanes* ow, uint64_t us) {
+  uint64_t old = ow->lat_ewma_us.load(std::memory_order_relaxed);
+  ow->lat_ewma_us.store(old - old / 8 + us / 8,
+                        std::memory_order_relaxed);
+}
 
 // process-global tallies (every LB's lanes), pump_counters idiom —
 // /metrics surfaces them as vproxy_lane_*_total
@@ -2176,6 +2300,7 @@ static std::atomic<uint64_t> g_lane_accepted(0), g_lane_served(0),
 
 int vtl_lane_rec_size(void) { return (int)sizeof(LaneRec); }
 int vtl_lane_punt_size(void) { return (int)sizeof(LanePunt); }
+int vtl_maglev_rec_size(void) { return (int)sizeof(MaglevRec); }
 
 static void addr_str(const sockaddr_storage* ss, char* ip, int iplen,
                      uint16_t* port) {
@@ -2237,7 +2362,7 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   }
   if (ow->punt_all.load(std::memory_order_relaxed) ||
       ow->close_listeners.load(std::memory_order_relaxed) || !rt ||
-      rt->seq.empty() ||
+      (rt->seq.empty() && rt->maglev.empty()) ||
       (int64_t)ow->active.load(std::memory_order_relaxed) >=
           ow->max_active.load(std::memory_order_relaxed)) {
     ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
@@ -2253,8 +2378,39 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
     lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
     return;
   }
-  int bidx = rt->seq[ow->wrr.fetch_add(1, std::memory_order_relaxed) %
-                     rt->seq.size()];
+  int bidx;
+  if (!rt->maglev.empty()) {
+    // consistent-hash pick: one FNV over the client addr (+port when
+    // per-connection spread is configured) + one table load. The uring
+    // multishot accept reports no peer address — resolve it here.
+    sockaddr_storage local;
+    if (!ss) {
+      socklen_t sl = sizeof(local);
+      if (getpeername(cfd, (sockaddr*)&local, &sl) == 0) ss = &local;
+    }
+    uint8_t ipb[16];
+    int iplen = 0, cport = 0;
+    if (!ss || !maglev_addr_bytes(ss, ipb, &iplen, &cport)) {
+      // no hashable address (AF_UNIX peer): the python path decides
+      ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
+      g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
+      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+      return;
+    }
+    bidx = maglev_lookup(rt->maglev.data(), (int)rt->maglev.size(), ipb,
+                         iplen, cport, rt->maglev_hash_port);
+    if (bidx < 0 || bidx >= (int)rt->backends.size()) {
+      // slot owned by a backend whose address failed to resolve at
+      // install time: punt, never guess
+      ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
+      g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
+      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+      return;
+    }
+  } else {
+    bidx = rt->seq[ow->wrr.fetch_add(1, std::memory_order_relaxed) %
+                   rt->seq.size()];
+  }
   errno = 0;
   uint64_t pid = pump_connect_impl(ln->loop, cfd,
                                    (sockaddr*)&rt->addrs[bidx],
@@ -2265,6 +2421,11 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
     lane_emit_punt(ln, cfd, LANE_PUNT_CONNECT_FAIL,
                    errno ? errno : ECONNREFUSED, ss, &rt->backends[bidx]);
     return;
+  }
+  {
+    auto pit = ln->loop->pumps.find(pid);
+    if (pit != ln->loop->pumps.end() && !pit->second->b_connecting)
+      lanes_lat_obs(ow, pit->second->connect_us);  // sync connect: ~0us
   }
   ln->meta[pid] = ConnMeta{rt, bidx, 0, mono_us()};
   ow->active.fetch_add(1, std::memory_order_relaxed);
@@ -2406,6 +2567,7 @@ static void lane_event(Lane* ln, Handler* h, uint32_t e) {
         } else {
           p->b_connecting = false;
           p->connect_us = mono_us() - p->created_us;
+          lanes_lat_obs(ln->owner, p->connect_us);
           Handler* ha =
               l->handlers.count(p->fd_a) ? l->handlers[p->fd_a] : nullptr;
           if (ha) ep_set(l, ha, VTL_EV_READ);
@@ -2668,6 +2830,50 @@ int vtl_lane_install(void* lp, const void* recs, int n,
   return (int)rt->seq.size();
 }
 
+// Install the compiled maglev route: n MaglevRec backends plus the
+// slot->backend table (m entries, values indexing recs; -1 = unowned).
+// Stamped + raced exactly like vtl_lane_install (-EAGAIN recompiles);
+// returns the usable table size. hash_port=0 gives source affinity
+// (client address only), 1 per-connection spread (address + port).
+int vtl_lane_maglev_install(void* lp, const void* recs, int n,
+                            const int32_t* table, int m, int hash_port,
+                            uint64_t gen) {
+  Lanes* ow = (Lanes*)lp;
+  if (m < 0 || n < 0) return -EINVAL;
+  if (gen != ow->gen.load(std::memory_order_relaxed)) return -EAGAIN;
+  auto rt = std::make_shared<LaneRoute>();
+  rt->gen = gen;
+  rt->maglev_hash_port = hash_port ? 1 : 0;
+  const MaglevRec* r = (const MaglevRec*)recs;
+  std::vector<int32_t> remap((size_t)(n > 0 ? n : 0), -1);
+  for (int i = 0; i < n; ++i) {
+    char ipb[48];
+    memcpy(ipb, r[i].ip, 46);
+    ipb[46] = 0;
+    sockaddr_storage ss;
+    socklen_t sl;
+    if (mk_addr(ipb, r[i].port, r[i].v6, &ss, &sl) < 0) continue;
+    remap[i] = (int32_t)rt->backends.size();
+    LaneRec lr;
+    memcpy(lr.ip, r[i].ip, 46);
+    lr.port = r[i].port;
+    lr.v6 = r[i].v6;
+    lr.weight = r[i].weight;
+    rt->backends.push_back(lr);
+    rt->addrs.push_back(ss);
+    rt->lens.push_back(sl);
+  }
+  rt->maglev.resize((size_t)m, -1);
+  for (int j = 0; j < m; ++j)
+    if (table[j] >= 0 && table[j] < n) rt->maglev[j] = remap[table[j]];
+  if (rt->backends.empty()) rt->maglev.clear();  // punt-everything entry
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    ow->route = rt;
+  }
+  return (int)rt->maglev.size();
+}
+
 int vtl_lanes_set_punt_all(void* lp, int on) {
   ((Lanes*)lp)->punt_all.store(on ? 1 : 0, std::memory_order_relaxed);
   return 0;
@@ -2701,7 +2907,8 @@ int vtl_lanes_set_shed(void* lp, int on) {
 }
 
 // out: accepted, served, active, punt_classic, punt_stale, punt_fail,
-// bytes, gen, engine, port, killed, shed -> 12 (this Lanes object only)
+// bytes, gen, engine, port, killed, shed, accept-latency EWMA us
+// -> 13 (this Lanes object only)
 int vtl_lanes_stat(void* lp, uint64_t* out) {
   Lanes* ow = (Lanes*)lp;
   if (!ow) return -EINVAL;
@@ -2717,7 +2924,8 @@ int vtl_lanes_stat(void* lp, uint64_t* out) {
   out[9] = (uint64_t)ow->port;
   out[10] = ow->killed.load(std::memory_order_relaxed);
   out[11] = ow->shed.load(std::memory_order_relaxed);
-  return 12;
+  out[12] = ow->lat_ewma_us.load(std::memory_order_relaxed);
+  return 13;
 }
 
 // process-global: accepted, served, punt_classic, punt_stale, punt_fail

@@ -138,6 +138,18 @@ def _load() -> ctypes.CDLL:
         lib.vtl_close_rst.argtypes = [c]
     except AttributeError:
         pass
+    try:  # maglev consistent-hash pick (absent from a prebuilt pre-r11 .so)
+        lib.vtl_maglev_rec_size.argtypes = []
+        lib.vtl_maglev_pick.argtypes = [ctypes.POINTER(ctypes.c_int32), c,
+                                        ctypes.c_char_p, c, c, c]
+        lib.vtl_lane_maglev_install.argtypes = [
+            p, ctypes.c_char_p, c, ctypes.POINTER(ctypes.c_int32), c, c,
+            u64]
+        lib.vtl_flow_maglev_install.argtypes = [
+            p, ctypes.POINTER(ctypes.c_int32), c, u64]
+        lib.vtl_flow_maglev_pick.argtypes = [p, ctypes.c_char_p, c, c, c]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -656,6 +668,8 @@ def switch_poll(handle: int, fd: int):
 
 # ip 46s, port u16, v6 u8, weight u8 — must match the C LaneRec
 LANE_REC = struct.Struct("<46sHBB")
+# same layout, separate ABI guard — must match the C MaglevRec
+MAGLEV_REC = struct.Struct("<46sHBB")
 # fd i32, kind i32, err i32, cport u16, bport u16, cip 46s, bip 46s
 LANE_PUNT = struct.Struct("<iiiHH46s46s")
 LANE_PUNT_CLASSIC = 0
@@ -779,14 +793,63 @@ def lane_install(handle: int, packed: bytes, n: int, seq: list,
     return int(LIB.vtl_lane_install(handle, packed, n, arr, len(seq), gen))
 
 
+def maglev_supported() -> bool:
+    """Native provider with the maglev symbols AND a matching install-
+    record ABI (a stale committed .so fails the size check and every
+    maglev-mode lane compile falls back to the WRR/punt paths)."""
+    if PROVIDER != "native" or not hasattr(LIB, "vtl_lane_maglev_install"):
+        return False
+    try:
+        return int(LIB.vtl_maglev_rec_size()) == MAGLEV_REC.size
+    except Exception:
+        return False
+
+
+def lane_maglev_install(handle: int, packed: bytes, n: int, table,
+                        hash_port: bool, gen: int) -> int:
+    """Install n MAGLEV_REC backends + the slot->backend table (an
+    int32 numpy array / sequence from rules/maglev.build_table), stamped
+    with `gen` like lane_install; hash_port=False = source affinity.
+    -> table size installed, or -EAGAIN on a raced mutation."""
+    arr = (ctypes.c_int32 * len(table))(*[int(x) for x in table])
+    return int(LIB.vtl_lane_maglev_install(handle, packed, n, arr,
+                                           len(table),
+                                           1 if hash_port else 0, gen))
+
+
+def maglev_pick(table, ip: bytes, port: int,
+                hash_port: bool = True) -> int:
+    """Pick through the EXACT C lookup the lanes run (parity surface);
+    -1 on an empty table. Raises on a .so without the symbol."""
+    arr = (ctypes.c_int32 * len(table))(*[int(x) for x in table])
+    return int(LIB.vtl_maglev_pick(arr, len(table), ip, len(ip), port,
+                                   1 if hash_port else 0))
+
+
+def flow_maglev_install(handle: int, table, gen: int) -> int:
+    """Attach the maglev table to a flow cache (generation-gated like
+    flow_install: 0 when a mutation landed since `gen` was read)."""
+    arr = (ctypes.c_int32 * len(table))(*[int(x) for x in table])
+    return int(LIB.vtl_flow_maglev_install(handle, arr, len(table), gen))
+
+
+def flow_maglev_pick(handle: int, ip: bytes, port: int,
+                     hash_port: bool = True) -> int:
+    """Pick through a flow cache's attached table; -1 when none."""
+    return int(LIB.vtl_flow_maglev_pick(handle, ip, len(ip), port,
+                                        1 if hash_port else 0))
+
+
 def lanes_stat(handle: int) -> tuple:
     """(accepted, served, active, punt_classic, punt_stale, punt_fail,
-    bytes, gen, engine, port, killed[, shed]) for ONE lanes object —
-    killed = lane-initiated teardowns (idle expiry, shutdown aborts),
-    counted apart from served so hit_rate stays honest; shed =
-    over-limit accepts RST-closed in C (adaptive overload; absent from
-    a prebuilt pre-r10 .so, which returns 11 fields)."""
-    out = (ctypes.c_uint64 * 12)()
+    bytes, gen, engine, port, killed[, shed[, lat_ewma_us]]) for ONE
+    lanes object — killed = lane-initiated teardowns (idle expiry,
+    shutdown aborts), counted apart from served so hit_rate stays
+    honest; shed = over-limit accepts RST-closed in C (adaptive
+    overload; absent from a prebuilt pre-r10 .so, which returns 11
+    fields); lat_ewma_us = the C-plane accept->backend-connected EWMA
+    the adaptive controller folds in (pre-r11 .so: 12 fields)."""
+    out = (ctypes.c_uint64 * 13)()
     n = check(LIB.vtl_lanes_stat(handle, out))
     return tuple(int(out[i]) for i in range(n))
 

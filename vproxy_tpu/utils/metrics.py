@@ -334,6 +334,10 @@ class GlobalInspection:
         # silent-drop accounting (udp_drop_incr below): created eagerly
         # so a scrape shows the zero before the first drop
         self.get_counter("vproxy_udp_drop_total")
+        # maglev table-compiler accounting (rules/maglev.py): eager for
+        # the same reason — a scrape shows the zeros before any build
+        self.get_counter("vproxy_maglev_table_builds_total")
+        self.get_gauge("vproxy_maglev_remap_fraction")
 
     @staticmethod
     def _classify_stat(key: str) -> float:
@@ -418,6 +422,10 @@ class GlobalInspection:
     def get_counter(self, name: str, **labels) -> Counter:
         return self._get_named(name, labels,
                                lambda: Counter(name, labels))  # type: ignore[return-value]
+
+    def get_gauge(self, name: str, **labels) -> Gauge:
+        return self._get_named(name, labels,
+                               lambda: Gauge(name, labels))  # type: ignore[return-value]
 
     def get_histogram(self, name: str, buckets: int = 27, reservoir: int = 0,
                       **labels) -> Histogram:
