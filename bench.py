@@ -286,6 +286,8 @@ def child():
     try:
         if os.environ.get("BENCH_STAGE") == "pjit":
             return _pjit_child()
+        if os.environ.get("BENCH_STAGE") == "fused":
+            return _fused_child()
         return _child_run()
     except BaseException as e:
         _write_child_error(e)
@@ -1331,6 +1333,214 @@ def pjit_1m_section(ph, result, dl) -> None:
         ph.done(error=repr(e)[:120])
 
 
+# ------------------------------------------------------- fused stage
+
+def _fused_child():
+    """The fused classify+pick stage (single-device CPU env — the fused
+    path is the single-table "jax" backend; the forced-8 virtual mesh
+    of the pjit stage is exactly the overhead fusion routes around).
+    Same-run fused/unfused A/B at 100k and 1M rules on the BENCH_r08
+    load shape (batch 4096, mps = 2*batch*iters/dt for the hint+cidr
+    pair — picks ride along free on the fused path), median-of-3
+    interleaved (the PR-8 discipline), launch-counter deltas as the
+    one-launch evidence. The committed artifact is
+    BENCH_r12_builder_fused.json."""
+    stage = os.environ.get("BENCH_STAGE", "fused")
+    ph = Phases(os.environ.get("BENCH_PHASE_FILE", ""), stage)
+    here = os.path.dirname(os.path.abspath(__file__))
+    dl = Deadline(_env_float("BENCH_CHILD_BUDGET", 900.0))
+    _enable_compile_cache(here)
+    import jax
+    result = {"stage": stage, "partial": True,
+              "fused_platform": jax.devices()[0].platform,
+              "fused_devices": len(jax.devices())}
+    result_file = os.environ.get("BENCH_RESULT_FILE")
+
+    def flush():
+        if result_file:
+            with open(result_file + ".tmp", "w") as f:
+                json.dump(result, f)
+            os.replace(result_file + ".tmp", result_file)
+
+    fused_ab_section(ph, result, dl,
+                     _env_int("BENCH_FUSED_SMALL_RULES", 100_000), "100k")
+    flush()
+    if dl.remaining() > 240:
+        fused_ab_section(ph, result, dl,
+                         _env_int("BENCH_FUSED_BIG_RULES", 1_000_000),
+                         "1m")
+        flush()
+    # the acceptance comparison: fused 1M throughput vs the committed
+    # BENCH_r08 dispatch-chain number at the same load shape
+    try:
+        with open(os.path.join(here, "BENCH_r08_builder_pjit.json")) as f:
+            r08 = json.load(f).get("classify_1m_rules_mps")
+        if r08 and result.get("fused_1m_mps"):
+            result["r08_classify_1m_rules_mps"] = r08
+            result["fused_1m_vs_r08_chain"] = round(
+                result["fused_1m_mps"] / r08, 2)
+    except (OSError, ValueError):
+        pass
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    result["engine_metrics"] = {
+        k: v for k, v in GlobalInspection.get().bench_snapshot().items()
+        if k.startswith("vproxy_engine_")}
+    result["partial"] = False
+    flush()
+    print(json.dumps(result))
+    return 0
+
+
+def fused_ab_section(ph, result, dl, n_rules, label) -> None:
+    """One table size: build "jax" hint+cidr tables + the maglev
+    column, parity spot-check the fused program, then interleaved
+    unfused/fused reps. Launch accounting rides engine.note_launch."""
+    import gc
+
+    from vproxy_tpu.rules import engine as E
+    from vproxy_tpu.rules.engine import (CidrMatcher, HintMatcher,
+                                         fused_dispatch_all)
+    from vproxy_tpu.rules.ir import Hint
+    from vproxy_tpu.rules.maglev import MaglevMatcher
+    batch = _env_int("BENCH_FUSED_BATCH", 4096)
+    try:
+        ph.start(f"fused_{label}_build")
+        rules = _pjit_hint_rules(n_rules)
+        t0 = time.time()
+        hm = HintMatcher(rules, backend="jax")
+        hint_build = time.time() - t0
+        nets = _pjit_nets(n_rules)
+        t0 = time.time()
+        cm = CidrMatcher(nets, backend="jax")
+        cidr_build = time.time() - t0
+        mm = MaglevMatcher([(f"10.8.{i}.1:80", 1 + i % 4)
+                            for i in range(12)])
+        packed = (hm.fused_stat().get("packed_bytes", 0)
+                  + cm.fused_stat().get("packed_bytes", 0))
+        ph.done(hint_build_s=round(hint_build, 1),
+                cidr_build_s=round(cidr_build, 1), packed_bytes=packed)
+
+        hints = [Hint.of_host(
+            f"svc{i % n_rules}.ns{i % 997}.pjit.example.com")
+            for i in range(batch)]
+        addrs = [bytes([10 + ((i * 7 >> 18) & 0x3F), (i * 7 >> 10) & 0xFF,
+                        (i * 7 >> 2) & 0xFF, i & 0xFF])
+                 for i in range(batch)]
+        ips = [bytes([10 + ((i * 13 >> 18) & 0x3F), (i * 13 >> 10) & 0xFF,
+                      (i * 13 >> 2) & 0xFF, (i * 5) & 0xFF])
+               for i in range(batch)]
+        hsnap, csnap, msnap = hm.snapshot(), cm.snapshot(), mm.snapshot()
+
+        ph.start(f"fused_{label}_warm_parity")
+        out = np.asarray(fused_dispatch_all(
+            hm, hsnap, cm, csnap, mm, msnap, hints, addrs, ips))[:batch]
+        np.asarray(hm.dispatch_snap(hsnap, hints))  # warm unfused too
+        np.asarray(cm.dispatch_snap(csnap, addrs, None))
+        np.asarray(mm.dispatch_snap(msnap, ips))
+        # parity spot-check against the host planes before timing —
+        # a fast wrong answer is worthless
+        for i in range(0, batch, max(1, batch // 16)):
+            assert int(out[i, 0]) == hm.index_snap(hsnap, hints[i]), \
+                f"verdict parity @{i}"
+            assert int(out[i, 1]) == mm.pick_snap(msnap, ips[i]), \
+                f"pick parity @{i}"
+            assert int(out[i, 2]) == cm.index_snap(csnap, addrs[i]), \
+                f"route parity @{i}"
+        ph.done()
+
+        iters = _env_int("BENCH_FUSED_ITERS", 5)
+        reps = _env_int("BENCH_FUSED_REPS", 3)
+        fused_mps, unfused_mps = [], []
+        fused_lpb, unfused_lpb = [], []
+        for rep in range(reps):  # interleaved: every rep runs BOTH
+            ph.start(f"fused_{label}_unfused_{rep}")
+            l0 = E.dispatch_launches_total()
+            t0 = time.time()
+            for _ in range(iters):
+                ha = hm.dispatch_snap(hsnap, hints)
+                ca = cm.dispatch_snap(csnap, addrs, None)
+                pa = mm.dispatch_snap(msnap, ips)
+                np.asarray(ha)
+                np.asarray(ca)
+                np.asarray(pa)
+            dt = time.time() - t0
+            unfused_mps.append(2 * batch * iters / dt)
+            unfused_lpb.append(
+                (E.dispatch_launches_total() - l0) / iters)
+            ph.done(mps=round(unfused_mps[-1], 1),
+                    launches_per_batch=unfused_lpb[-1])
+            ph.start(f"fused_{label}_fused_{rep}")
+            l0 = E.dispatch_launches_total()
+            t0 = time.time()
+            for _ in range(iters):
+                np.asarray(fused_dispatch_all(
+                    hm, hsnap, cm, csnap, mm, msnap, hints, addrs, ips))
+            dt = time.time() - t0
+            fused_mps.append(2 * batch * iters / dt)
+            fused_lpb.append((E.dispatch_launches_total() - l0) / iters)
+            ph.done(mps=round(fused_mps[-1], 1),
+                    launches_per_batch=fused_lpb[-1])
+        f_med = float(np.median(fused_mps))
+        u_med = float(np.median(unfused_mps))
+        result.update({
+            f"fused_{label}_mps": round(f_med, 1),
+            f"fused_{label}_mps_reps": [round(x, 1) for x in fused_mps],
+            f"unfused_{label}_mps": round(u_med, 1),
+            f"unfused_{label}_mps_reps":
+                [round(x, 1) for x in unfused_mps],
+            f"fused_{label}_vs_unfused": round(f_med / u_med, 3)
+                if u_med > 0 else -1.0,
+            f"fused_{label}_launches_per_batch": fused_lpb[-1],
+            f"unfused_{label}_launches_per_batch": unfused_lpb[-1],
+            f"fused_{label}_batch": batch,
+            f"fused_{label}_hint_build_s": round(hint_build, 1),
+            f"fused_{label}_cidr_build_s": round(cidr_build, 1),
+            f"fused_{label}_hint_table_bytes": hm.published_table_bytes(),
+            f"fused_{label}_packed_bytes": packed,
+            f"fused_{label}_parity_ok": True,
+        })
+        del hm, cm, mm, hsnap, csnap, msnap, out
+        gc.collect()
+    except MemoryError:
+        raise
+    except Exception as e:
+        result[f"fused_{label}_error"] = repr(e)[:300]
+        ph.done(error=repr(e)[:120])
+
+
+def _run_fused_stage(timeout):
+    """The fused stage in a single-device CPU subprocess; folds the
+    headline A/B + launch rows into the round artifact."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_fused.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["BENCH_STAGE"] = "fused"
+    env["BENCH_PHASE_FILE"] = os.environ.get("BENCH_PHASE_FILE", "")
+    env["BENCH_RESULT_FILE"] = result_file
+    env.setdefault("BENCH_CHILD_BUDGET", str(max(60.0, timeout - 15.0)))
+    sys.stderr.write(f"# === stage fused (timeout {timeout:.0f}s) ===\n")
+    sys.stderr.flush()
+    p = _run_child([sys.executable, os.path.abspath(__file__),
+                    "--child"], env, here)
+    _wait_stage(p, "fused", timeout, term_grace=20)
+    if os.path.exists(result_file):
+        try:
+            with open(result_file) as f:
+                res = json.load(f)
+            out = {k: v for k, v in res.items()
+                   if k not in ("stage", "partial", "engine_metrics")}
+            if res.get("partial"):
+                out["fused_partial"] = True
+            return out
+        except ValueError:
+            pass
+    sys.stderr.write("# stage fused: no result\n")
+    return {}
+
+
 def _wait_stage(p, name, timeout, term_grace=10):
     """Shared stage-child lifecycle: wait, SIGTERM (the child's handler
     runs its own cleanup), SIGKILL, abandon — ONE copy; this block used
@@ -1829,6 +2039,10 @@ def orchestrate():
     result.update(_run_maglev_stage(
         float(os.environ.get("BENCH_MAGLEV_TIMEOUT", "300"))))
     publish(result)
+    # fused classify+pick: one-launch A/B + launch-counter evidence
+    result.update(_run_fused_stage(
+        float(os.environ.get("BENCH_FUSED_TIMEOUT", "900"))))
+    publish(result)
     result["phases"] = _read_phases(phase_file)
     # complete: disarm the handler so a late SIGTERM can't emit a second
     # (or interleaved) headline line after this one
@@ -1854,5 +2068,10 @@ if __name__ == "__main__":
         print(json.dumps(_run_maglev_stage(
             float(os.environ.get("BENCH_MAGLEV_TIMEOUT", "300")))))
         sys.exit(0)
+    elif "--fused" in sys.argv:  # manual: the fused stage in-process
+        from vproxy_tpu.utils.jaxenv import force_cpu
+        force_cpu()
+        os.environ["BENCH_STAGE"] = "fused"
+        sys.exit(_fused_child())
     else:
         sys.exit(orchestrate())

@@ -1,0 +1,531 @@
+"""Fused classify+pick dispatch (ops/fused.py + rules/engine.py).
+
+The one-launch contract: a batch's verdict (hint match) AND pick
+(Maglev) — optionally the cidr/LPM route too — come from ONE compiled
+program over int8/int32-packed tables, bit-identical to the unfused
+op chain, published through the same double-buffered TableInstaller
+swap, with the launch counter proving "one launch per batch" instead
+of asserting it.
+"""
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from vproxy_tpu.rules import engine
+from vproxy_tpu.rules.engine import (CidrMatcher, HintMatcher,
+                                     fused_dispatch, fused_dispatch_all)
+from vproxy_tpu.rules.ir import Hint, HintRule
+from vproxy_tpu.rules.maglev import FusedPair, MaglevMatcher, \
+    classify_and_pick
+from vproxy_tpu.utils import failpoint
+from vproxy_tpu.utils.ip import Network, mask_bytes
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+def mk_rules(n, seed=11):
+    rnd = random.Random(seed)
+    out = []
+    for i in range(n):
+        r = rnd.randrange(20)
+        if r < 12:
+            out.append(HintRule(host=f"svc{i}.ns{i % 997}.example.com"))
+        elif r < 15:
+            out.append(HintRule(host=f"svc{i}.ns{i % 997}.example.com",
+                                uri=f"/api/v{i % 17}"))
+        elif r < 17:
+            out.append(HintRule(host=f"svc{i}.ns{i % 997}.example.com",
+                                port=443))
+        elif r < 19:
+            out.append(HintRule(uri=f"/static/{i}"))
+        else:
+            out.append(HintRule(host="*", uri=f"/w{i % 5}"))
+    return out
+
+
+def mk_queries(rules, b, seed=7):
+    rnd = random.Random(seed)
+    hints = []
+    for i in range(b):
+        j = rnd.randrange(len(rules))
+        host = rules[j].host
+        if host is None or host == "*":
+            host = f"nohost{j}.ns.example.com"
+        k = i % 4
+        if k == 0:
+            hints.append(Hint.of_host(host))
+        elif k == 1:
+            hints.append(Hint.of_host_uri("x." + host, f"/api/v{j % 17}/s"))
+        elif k == 2:
+            hints.append(Hint.of_host_port(host, 443 if i % 2 else 8443))
+        else:
+            hints.append(Hint(uri=f"/static/{j}"))
+    return hints
+
+
+def mk_ips(n, seed=5):
+    rnd = random.Random(seed)
+    return [bytes([10 + rnd.randrange(14), rnd.randrange(256),
+                   rnd.randrange(256), rnd.randrange(256)])
+            for _ in range(n)]
+
+
+def mk_nets(n, seed=13):
+    rnd = random.Random(seed)
+    nets = []
+    for i in range(n):
+        ml = rnd.choice([8, 12, 16, 20, 24, 28, 32])
+        ip = bytes([10 + (i % 13), rnd.randrange(256), rnd.randrange(256),
+                    rnd.randrange(256)])
+        mk = mask_bytes(ml)
+        nets.append(Network(bytes(np.frombuffer(ip, np.uint8) &
+                                  np.frombuffer(mk, np.uint8)), mk))
+    return nets
+
+
+def _unfused_chain(hm, mm, hints, ips, ports=None):
+    """The pre-r12 op chain: hint dispatch + maglev pick dispatch."""
+    hsnap, msnap = hm.snapshot(), mm.snapshot()
+    v = np.asarray(hm.dispatch_snap(hsnap, hints))
+    p = np.asarray(mm.dispatch_snap(msnap, ips, ports))
+    return v, p
+
+
+# ------------------------------------------------------------- parity
+
+
+def _parity_case(n_rules, b):
+    rules = mk_rules(n_rules)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([(f"10.9.{i // 250}.{i % 250}:80", 1 + i % 4)
+                        for i in range(11)], m=4099)
+    hints = mk_queries(rules, b)
+    ips = mk_ips(b)
+    ports = [None if i % 3 == 0 else (1024 + i) for i in range(b)]
+    rv, rp = _unfused_chain(hm, mm, hints, ips, ports)
+    out = np.asarray(fused_dispatch(hm, hm.snapshot(), mm, mm.snapshot(),
+                                    hints, ips, ports))[:b]
+    assert np.array_equal(rv, out[:, 0]), "verdicts diverged"
+    assert np.array_equal(rp, out[:, 1]), "picks diverged"
+    # and through the public entry (padding path included)
+    v2, p2, _hp, _mp = classify_and_pick(hm, mm, hints, ips, ports)
+    assert np.array_equal(rv, v2) and np.array_equal(rp, p2)
+
+
+def test_fused_parity_randomized_100k():
+    """The acceptance bar: randomized 100k-rule table, fused ==
+    unfused, verdict AND pick bit-identical."""
+    _parity_case(100_000, 512)
+
+
+def test_fused_parity_uri_free_specialized_table():
+    """A generation with zero uri rules packs WITHOUT the uri sweep
+    (ops/fused.py static specialization — the bench/production pure-
+    host shape); parity must hold including uri-carrying queries."""
+    rules = [HintRule(host=f"svc{i}.ns{i % 97}.example.com")
+             for i in range(5_000)]
+    rules += [HintRule(host="*"), HintRule(host="w.example.com",
+                                           port=443)]
+    hm = HintMatcher(rules, backend="jax")
+    assert "pk_uslot" not in hm.snapshot()[5]  # specialized layout
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(4)], m=251)
+    b = 96
+    hints = [Hint.of_host(f"svc{i}.ns{i % 97}.example.com")
+             for i in range(b - 3)]
+    hints += [Hint(host="w.example.com", uri="/ignored", port=443),
+              Hint(uri="/only-uri"), Hint()]
+    ips = mk_ips(b)
+    rv, rp = _unfused_chain(hm, mm, hints, ips)
+    out = np.asarray(fused_dispatch(hm, hm.snapshot(), mm,
+                                    mm.snapshot(), hints, ips))[:b]
+    assert np.array_equal(rv, out[:, 0])
+    assert np.array_equal(rp, out[:, 1])
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_fused_parity_randomized_1m_slow():
+    _parity_case(1_000_000, 1024)
+
+
+def test_fused_all_route_parity():
+    """The 3-column form: verdict + pick + cidr/LPM route in one
+    launch, route bit-identical to the unfused cidr dispatch."""
+    rules = mk_rules(5_000)
+    nets = mk_nets(5_000)
+    hm = HintMatcher(rules, backend="jax")
+    cm = CidrMatcher(nets, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(5)], m=251)
+    b = 128
+    hints = mk_queries(rules, b)
+    addrs = mk_ips(b, seed=29)
+    ips = mk_ips(b)
+    rv, rp = _unfused_chain(hm, mm, hints, ips)
+    rr = np.asarray(cm.dispatch_snap(cm.snapshot(), addrs, None))
+    out = np.asarray(fused_dispatch_all(
+        hm, hm.snapshot(), cm, cm.snapshot(), mm, mm.snapshot(),
+        hints, addrs, ips))[:b]
+    assert np.array_equal(rv, out[:, 0])
+    assert np.array_equal(rp, out[:, 1])
+    assert np.array_equal(rr, out[:, 2])
+
+
+def test_fused_pad_rows_never_match():
+    rules = mk_rules(300)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([("b0", 1)], m=251)
+    hints = mk_queries(rules, 3)
+    ips = mk_ips(3)
+    out = np.asarray(fused_dispatch(hm, hm.snapshot(), mm, mm.snapshot(),
+                                    hints, ips, pad_to=16))
+    assert out.shape[0] == 16
+    assert (out[3:, 0] == -1).all()  # pad rows: invalid probes only
+
+
+def test_fused_unavailable_fallbacks():
+    """Non-"jax" backends and VPROXY_TPU_FUSED=0 publish no packed
+    tables; classify_and_pick falls back to the overlapped chain with
+    identical results."""
+    rules = mk_rules(300)
+    hm_host = HintMatcher(rules, backend="host")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(3)], m=251)
+    assert fused_dispatch(hm_host, hm_host.snapshot(), mm, mm.snapshot(),
+                          mk_queries(rules, 4), mk_ips(4)) is None
+    v, p, _hp, _mp = classify_and_pick(hm_host, mm, mk_queries(rules, 4),
+                                       mk_ips(4))
+    assert len(v) == 4 and len(p) == 4
+
+
+def test_fused_disabled_by_knob(monkeypatch):
+    monkeypatch.setenv("VPROXY_TPU_FUSED", "0")
+    hm = HintMatcher(mk_rules(64), backend="jax")
+    assert hm.fused_stat() == {"available": False}
+    mm = MaglevMatcher([("b0", 1)], m=251)
+    assert fused_dispatch(hm, hm.snapshot(), mm, mm.snapshot(),
+                          mk_queries(hm.rules, 4), mk_ips(4)) is None
+    monkeypatch.delenv("VPROXY_TPU_FUSED")
+    hm.set_rules(mk_rules(64))  # next generation re-packs
+    assert hm.fused_stat()["available"]
+
+
+# ------------------------------------------------- one-launch counter
+
+
+def test_fused_one_launch_per_batch_counter():
+    """The scrape-verifiable claim: a fused batch moves the dispatch
+    launch counter by EXACTLY one; the unfused chain by two."""
+    rules = mk_rules(400)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(4)], m=251)
+    hints = mk_queries(rules, 32)
+    ips = mk_ips(32)
+    classify_and_pick(hm, mm, hints, ips)  # warm both jits
+    _unfused_chain(hm, mm, hints, ips)
+    l0, f0 = engine.dispatch_launches_total(), \
+        engine.fused_dispatches_total()
+    v, p, _hp, _mp = classify_and_pick(hm, mm, hints, ips)
+    assert engine.dispatch_launches_total() - l0 == 1
+    assert engine.fused_dispatches_total() - f0 == 1
+    _unfused_chain(hm, mm, hints, ips)
+    assert engine.dispatch_launches_total() - l0 == 3  # +2 for the chain
+    assert engine.fused_dispatches_total() - f0 == 1
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    text = GlobalInspection.get().prometheus_string()
+    assert "vproxy_engine_dispatch_launches_total" in text
+    assert "vproxy_engine_fused_dispatches_total" in text
+
+
+# --------------------------------------- install-under-fused-load swap
+
+
+def test_install_under_fused_load_atomic_swap():
+    """engine.swap.stall: while a standby install (including the packed
+    tables) is deliberately stalled, fused dispatches keep answering
+    the OLD generation; after the atomic pub swap, the NEW one — and
+    the (verdict, pick) pair always comes from ONE snapshot pair.
+    Zero errors, zero torn reads."""
+    import os
+    os.environ["VPROXY_TPU_SWAP_STALL_S"] = "0.6"
+    old = [HintRule(host=f"svc{i}.example.com") for i in range(300)]
+    new = [HintRule(host=f"svc{i}.example.org") for i in range(300)]
+    hm = HintMatcher(old, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(4)], m=251)
+    h_old = Hint.of_host("svc7.example.com")   # 7 in old, -1 in new
+    h_new = Hint.of_host("svc7.example.org")   # -1 in old, 7 in new
+    ip = bytes([10, 0, 0, 7])
+    classify_and_pick(hm, mm, [h_old, h_new], [ip, ip])  # warm
+    want_pick = mm.pick_one(ip)
+
+    failpoint.arm("engine.swap.stall", count=1)
+    th = threading.Thread(target=lambda: hm.set_rules(new), daemon=True)
+    gen0 = hm.generation
+    th.start()
+    t0 = time.monotonic()
+    answered = 0
+    first_gen = None
+    while time.monotonic() - t0 < 5.0:
+        v, p, _hp, _mp = classify_and_pick(hm, mm, [h_old, h_new],
+                                           [ip, ip])
+        assert int(v[0]) in (7, -1) and int(v[1]) in (7, -1), v
+        assert int(p[0]) == want_pick and int(p[1]) == want_pick
+        if first_gen is None:
+            first_gen = hm.generation
+        answered += 1
+        if hm.generation > gen0:
+            break
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert hm.generation == gen0 + 1
+    assert answered >= 1 and first_gen == gen0
+    # post-swap: the NEW generation's packed tables serve
+    v, p, _hp, _mp = classify_and_pick(hm, mm, [h_old, h_new], [ip, ip])
+    assert int(v[0]) == -1 and int(v[1]) == 7
+    assert hm.fused_stat()["available"]
+
+
+def test_maglev_install_swaps_pick_atomically():
+    hm = HintMatcher(mk_rules(64), backend="jax")
+    mm = MaglevMatcher([("only:1", 1)], m=251)
+    ips = mk_ips(16)
+    hints = mk_queries(hm.rules, 16)
+    v, p, _hp, _mp = classify_and_pick(hm, mm, hints, ips)
+    assert (np.asarray(p) == 0).all()
+    mm.set_backends([("only:1", 1), ("second:2", 1)])
+    v, p, _hp, _mp = classify_and_pick(hm, mm, hints, ips)
+    msnap = mm.snapshot()
+    for i, ip in enumerate(ips):
+        assert int(p[i]) == mm.pick_snap(msnap, ip)
+    assert set(np.asarray(p).tolist()) <= {0, 1}
+
+
+# --------------------------------------------- fused-fn cache (knobs)
+
+
+def test_fused_fn_cache_keyed_on_kernel_knobs(monkeypatch):
+    """The PR-6 stale-mesh family: a VPROXY_TPU_* knob change
+    mid-process must select a fresh compiled program, never serve the
+    cached one for the old knob state."""
+    from vproxy_tpu.ops import fused as F
+    from vproxy_tpu.ops import fused_pallas as FP
+    monkeypatch.delenv("VPROXY_TPU_FUSED_KERNEL", raising=False)
+    monkeypatch.delenv("VPROXY_TPU_PALLAS_INTERPRET", raising=False)
+    FP.reset_probe()
+    fn0 = engine._fused_fn()
+    assert engine._fused_fn() is fn0  # stable under a stable key
+    assert engine.fused_kernel_name() == "jit"  # cpu probe refuses
+    monkeypatch.setenv("VPROXY_TPU_FUSED_KERNEL", "pallas")
+    monkeypatch.setenv("VPROXY_TPU_PALLAS_INTERPRET", "1")
+    FP.reset_probe()
+    fn1 = engine._fused_fn()
+    assert fn1 is not fn0, "knob change served a stale compiled program"
+    assert engine.fused_kernel_name() == "pallas"
+    monkeypatch.setenv("VPROXY_TPU_FUSED_KERNEL", "jit")
+    assert engine._fused_fn() is fn0
+    FP.reset_probe()
+
+
+def test_auto_mode_never_serves_interpret_pallas(monkeypatch):
+    """VPROXY_TPU_PALLAS_INTERPRET=1 is the bit-verify lane (~100x
+    slower per batch); in kernel mode "auto" it must NOT flip
+    production serving onto the interpreter — only an explicit
+    kernel=pallas serves it."""
+    from vproxy_tpu.ops import fused as F
+    from vproxy_tpu.ops import fused_pallas as FP
+    monkeypatch.delenv("VPROXY_TPU_FUSED_KERNEL", raising=False)
+    monkeypatch.setenv("VPROXY_TPU_PALLAS_INTERPRET", "1")
+    FP.reset_probe()
+    assert FP.pallas_supported()[0]  # the probe itself passes
+    assert engine._fused_fn() is F.fused_jit
+    assert engine.fused_kernel_name() == "jit"
+    monkeypatch.setenv("VPROXY_TPU_FUSED_KERNEL", "pallas")
+    assert engine._fused_fn() is FP.fused_classify_pick_pallas
+    FP.reset_probe()
+
+
+def test_fused_kernel_name_is_probe_free(monkeypatch):
+    """The stat surfaces (list-detail / HTTP detail) read the serving
+    tier on the control thread: fused_kernel_name must report from
+    CACHED state only, never trigger the capability probe (whose first
+    pass compiles and dispatches a kernel)."""
+    from vproxy_tpu.ops import fused as F
+    from vproxy_tpu.ops import fused_pallas as FP
+    monkeypatch.setenv("VPROXY_TPU_FUSED_KERNEL", "auto")
+    monkeypatch.setenv("VPROXY_TPU_PALLAS_INTERPRET", "1")
+    FP.reset_probe()
+    engine._FUSED_FN.pop(F.layout_key(), None)
+    assert engine.fused_kernel_name() == "jit"  # cold: the jit default
+    assert FP.probe_cached() is None, "stat read ran the probe"
+    FP.reset_probe()
+
+
+# ------------------------------------------------------- pallas tier
+
+
+def test_pallas_probe_honest_on_cpu(monkeypatch):
+    from vproxy_tpu.ops import fused_pallas as FP
+    monkeypatch.delenv("VPROXY_TPU_PALLAS_INTERPRET", raising=False)
+    FP.reset_probe()
+    ok, why = FP.pallas_supported()
+    assert not ok and "cpu" in why
+    FP.reset_probe()
+
+
+def test_pallas_interpret_bit_verify(monkeypatch):
+    """The real-hardware flip-on guard, exercised in interpret mode:
+    the Pallas kernel's (verdict, pick) is bit-identical to the fused
+    jit on a randomized table."""
+    from vproxy_tpu.ops import fused as F
+    from vproxy_tpu.ops import fused_pallas as FP
+    from vproxy_tpu.ops import hashmatch as H
+    monkeypatch.setenv("VPROXY_TPU_PALLAS_INTERPRET", "1")
+    FP.reset_probe()
+    ok, why = FP.pallas_supported()
+    assert ok, why
+    rules = mk_rules(400)
+    tab = H.compile_hint_hash(rules)
+    hints = mk_queries(rules, 24)
+    q = H.encode_hint_queries(hints, tab)
+    ht = F.pack_hint_table(tab.arrays)
+    from vproxy_tpu.rules.maglev import build_table, flow_hash
+    mtab = build_table([(f"b{i}", 1) for i in range(6)], m=251)
+    ips = mk_ips(24)
+    slots = np.array([flow_hash(ip) % 251 for ip in ips], np.int64)
+    ref = np.asarray(F.fused_jit(ht, q, mtab, slots))
+    got = np.asarray(FP.fused_classify_pick_pallas(ht, q, mtab, slots,
+                                                   interpret=True))
+    assert np.array_equal(ref, got)
+    FP.reset_probe()
+
+
+# ------------------------------------------------- service + step loop
+
+
+def test_service_cpick_batch_and_inline():
+    from vproxy_tpu.rules.service import ClassifyService
+    rules = mk_rules(300)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(5)], m=251)
+    pair = FusedPair(hm, mm)
+    hints = mk_queries(rules, 24)
+    ips = mk_ips(24)
+    msnap = mm.snapshot()
+    hsnap = hm.snapshot()
+
+    svc = ClassifyService(mode="device")
+    try:
+        got = {}
+        evs = []
+        for i in range(24):
+            ev = threading.Event()
+            evs.append(ev)
+            svc.submit_classify_pick(
+                pair, hints[i], ips[i], None,
+                lambda v, p, pl, i=i, ev=ev: (got.__setitem__(i, (v, p)),
+                                              ev.set()))
+        for ev in evs:
+            assert ev.wait(30)
+        for i in range(24):
+            assert got[i][0] == hm.index_snap(hsnap, hints[i])
+            assert got[i][1] == mm.pick_snap(msnap, ips[i])
+        assert svc.stats.dispatches >= 1
+    finally:
+        svc.close()
+
+    # lone query in auto mode: the inline host lane answers (v, p)
+    svc2 = ClassifyService(mode="auto")
+    try:
+        res = []
+        ev = threading.Event()
+        svc2.submit_classify_pick(pair, hints[3], ips[3], None,
+                                  lambda v, p, pl: (res.append((v, p)),
+                                                    ev.set()))
+        assert ev.wait(10)
+        assert res[0] == (hm.index_snap(hsnap, hints[3]),
+                          mm.pick_snap(msnap, ips[3]))
+    finally:
+        svc2.close()
+
+
+def test_service_cpick_device_fault_fails_over_to_host():
+    from vproxy_tpu.rules.service import ClassifyService
+    rules = mk_rules(300)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(3)], m=251)
+    pair = FusedPair(hm, mm)
+    hints = mk_queries(rules, 8)
+    ips = mk_ips(8)
+    hsnap, msnap = hm.snapshot(), mm.snapshot()
+    failpoint.arm("device.dispatch.error", count=1)
+    svc = ClassifyService(mode="device")
+    try:
+        got = {}
+        evs = []
+        for i in range(8):
+            ev = threading.Event()
+            evs.append(ev)
+            svc.submit_classify_pick(
+                pair, hints[i], ips[i], None,
+                lambda v, p, pl, i=i, ev=ev: (got.__setitem__(i, (v, p)),
+                                              ev.set()))
+        for ev in evs:
+            assert ev.wait(30)
+        # the batch that hit the fault served from the host planes —
+        # same winners, zero failed queries
+        for i in range(8):
+            assert got[i] == (hm.index_snap(hsnap, hints[i]),
+                              mm.pick_snap(msnap, ips[i]))
+        assert svc.stats.failovers >= 1
+    finally:
+        svc.close()
+
+
+def test_steploop_fused_pick_and_degraded_host_path():
+    from vproxy_tpu.cluster.submit import StepLoop
+    rules = mk_rules(300)
+    hm = HintMatcher(rules, backend="jax")
+    mm = MaglevMatcher([(f"b{i}", 1) for i in range(4)], m=251)
+    hints = mk_queries(rules, 4)
+    ips = mk_ips(4)
+    hsnap, msnap = hm.snapshot(), mm.snapshot()
+    sl = StepLoop(hm, None, step_ms=1, batch_cap=8, timeout_ms=2000,
+                  maglev=mm)
+    assert sl.status()["fused"]
+    sl.start()
+    try:
+        out, out2 = [], []
+        ev, ev2 = threading.Event(), threading.Event()
+        sl.submit_pick(hints[0], ips[0], None,
+                       lambda v, p, pl: (out.append((v, p)), ev.set()))
+        sl.submit(hints[1], lambda v, pl: (out2.append(v), ev2.set()))
+        assert ev.wait(15) and ev2.wait(15)
+        assert out[0] == (hm.index_snap(hsnap, hints[0]),
+                          mm.pick_snap(msnap, ips[0]))
+        assert out2[0] == hm.index_snap(hsnap, hints[1])
+        # degraded serving keeps picks flowing from the host planes
+        sl.degraded = True
+        ev3 = threading.Event()
+        out3 = []
+        sl.submit_pick(hints[2], ips[2], None,
+                       lambda v, p, pl: (out3.append((v, p)), ev3.set()))
+        assert ev3.wait(15)
+        assert out3[0] == (hm.index_snap(hsnap, hints[2]),
+                          mm.pick_snap(msnap, ips[2]))
+    finally:
+        sl.stop()
+
+
+def test_steploop_submit_pick_requires_maglev():
+    from vproxy_tpu.cluster.submit import StepLoop
+    sl = StepLoop(HintMatcher(mk_rules(8), backend="jax"), None,
+                  step_ms=1, batch_cap=4, timeout_ms=500)
+    with pytest.raises(ValueError):
+        sl.submit_pick(Hint.of_host("x.example.com"), b"\x00" * 4, None,
+                       lambda v, p, pl: None)

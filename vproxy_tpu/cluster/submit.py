@@ -64,9 +64,21 @@ class StepLoop:
 
     def __init__(self, matcher, membership: Optional[Membership] = None,
                  step_ms: int = 0, batch_cap: int = 0, timeout_ms: int = 0,
-                 on_degrade: Optional[Callable[[], None]] = None):
+                 on_degrade: Optional[Callable[[], None]] = None,
+                 maglev=None):
         self.matcher = matcher
         self.membership = membership
+        # optional Maglev plane: when a MaglevMatcher rides along, the
+        # step dispatch moves onto the FUSED one-launch entry
+        # (rules/engine.fused_dispatch via maglev.FusedPair) — a step
+        # answers verdicts AND backend picks from one compiled program,
+        # and submit_pick() queries get their pick at zero extra
+        # launches. Without it, the pre-r12 hint-only dispatch serves.
+        self.maglev = maglev
+        self._pair = None
+        if maglev is not None:
+            from ..rules.maglev import FusedPair
+            self._pair = FusedPair(matcher, maglev)
         self.step_ms = step_ms or STEP_MS
         self.batch_cap = batch_cap or BATCH
         self.timeout_ms = timeout_ms or STEP_TIMEOUT_MS
@@ -108,9 +120,8 @@ class StepLoop:
             # and degrade a perfectly healthy host at boot. Bounded —
             # a backend that cannot dispatch at all (no cross-process
             # collectives) surfaces on step 1 as the designed stall.
-            from ..rules.ir import Hint
             self._timed_dispatch(
-                [Hint()] * self.batch_cap,
+                [self._PAD_ITEM()] * self.batch_cap,
                 time.monotonic() + max(10.0, 3 * self.timeout_ms / 1000.0))
         self._thread = threading.Thread(target=self._run,
                                         name="cluster-step", daemon=True)
@@ -148,14 +159,49 @@ class StepLoop:
                           generation=epoch)
             _log.info(f"re-joined step dispatch at generation {epoch}")
 
+    @staticmethod
+    def _PAD_ITEM():
+        from ..rules.ir import Hint
+        return (Hint(), b"\x00\x00\x00\x00", None, None, False)
+
     def submit(self, hint, cb: Callable[[int, object], None]) -> None:
         if self._stopped:
             raise OSError("StepLoop is stopped")
         with self._qlock:
-            self._q.append((hint, cb))
+            self._q.append((hint, b"\x00\x00\x00\x00", None, cb, False))
+
+    def submit_pick(self, hint, ip: bytes, port: Optional[int],
+                    cb: Callable[[int, int, object], None]) -> None:
+        """Fused classify+pick through the step clock: cb(verdict,
+        pick, (hint_payload, maglev_payload)) after the step that
+        carried the query — the pick costs ZERO extra launches (it is
+        one more gather inside the step's fused program). Requires the
+        loop's maglev plane; port=None = source affinity."""
+        if self.maglev is None:
+            raise ValueError("StepLoop has no maglev plane configured")
+        if self._stopped:
+            raise OSError("StepLoop is stopped")
+        with self._qlock:
+            self._q.append((hint, ip, port, cb, True))
+
+    def _fused_live(self) -> bool:
+        """True only when the NEXT step would actually dispatch fused:
+        a maglev plane is configured AND the current publishes carry
+        the packed tables + maglev column (VPROXY_TPU_FUSED=0, a
+        non-"jax" backend, or a pre-fused publish all fall back to the
+        two-dispatch chain — status must say so, not report the
+        config)."""
+        if self._pair is None:
+            return False
+        hsnap = self.matcher.snapshot()
+        if len(hsnap) <= 5 or hsnap[5] is None:
+            return False
+        msnap = self.maglev.snapshot()
+        return msnap[0] is not None and msnap[1] is not None
 
     def status(self) -> dict:
         return {"epoch": self.epoch, "step": self._step,
+                "fused": self._fused_live(),
                 "degraded": self.degraded, "steps_total": self.steps_total,
                 "barrier_stalls": self.barrier_stalls,
                 "queued": len(self._q), "batch_cap": self.batch_cap,
@@ -223,12 +269,21 @@ class StepLoop:
 
     # ------------------------------------------------------------ dispatch
 
-    def _device_dispatch(self, hints: list):
+    def _device_dispatch(self, items: list):
+        """items: padded (hint, ip, port, cb, want_pick) rows. With a
+        maglev plane the step rides the FusedPair's one-launch
+        (verdict, pick) program; without it, the hint-only dispatch."""
         if failpoint.hit("cluster.step.stall"):
             # a wedged collective: the step deadline must fire and
             # degrade this host, never hang the fleet
             time.sleep(self.timeout_ms * 3 / 1000.0)
+        if self._pair is not None:
+            snap = self._pair.snapshot()
+            out = np.asarray(self._pair.dispatch_snap(
+                snap, [(h, ip, po) for h, ip, po, _, _ in items]))
+            return (out[: len(items)], self._pair.snap_payload(snap))
         snap = self.matcher.snapshot()
+        hints = [h for h, _, _, _, _ in items]
         return (np.asarray(self.matcher.dispatch_snap(snap, hints)),
                 self.matcher.snap_payload(snap))
 
@@ -297,7 +352,6 @@ class StepLoop:
     # ----------------------------------------------------------- main loop
 
     def _run(self) -> None:
-        from ..rules.ir import Hint
         next_step = time.monotonic()
         while not self._stopped:
             now = time.monotonic()
@@ -316,8 +370,8 @@ class StepLoop:
             deadline = time.monotonic() + self.timeout_ms / 1000.0
             out = None
             if self._barrier(deadline):
-                padded = [h for h, _ in batch] + \
-                    [Hint()] * (self.batch_cap - len(batch))
+                padded = list(batch) + \
+                    [self._PAD_ITEM()] * (self.batch_cap - len(batch))
                 out = self._timed_dispatch(padded, deadline)
             if out is self._EPOCH_ABORT:
                 # a rejoin landed mid-step (new generation): not a
@@ -354,18 +408,51 @@ class StepLoop:
         self._serve_host(batch)
 
     def _serve_host(self, batch: list) -> None:
+        """Degraded / epoch-abort serving: the inline host planes —
+        O(probes) hint index plus the O(1) host maglev table for pick
+        queries (same winners as the fused program, rules/index.py +
+        the shared FNV contract). Nothing fails."""
         if not batch:
             return
         m = self.matcher
         snap = m.snapshot()
-        payload = m.snap_payload(snap)
-        idxs = [m.index_snap(snap, h) for h, _ in batch]
-        self._deliver(batch, idxs, payload)
+        hp = m.snap_payload(snap)
+        msnap = None if self.maglev is None else self.maglev.snapshot()
+        for hint, ip, port, cb, want in batch:
+            v, pick = -1, -1
+            try:  # a broken row delivers -1, never strands its caller
+                v = int(m.index_snap(snap, hint))
+                if want:
+                    pick = int(self.maglev.pick_snap(msnap, ip, port))
+            except MemoryError:
+                raise
+            except Exception:
+                _log.error("step host classify failed; delivering "
+                           "no-match", exc=True)
+            try:
+                if want:
+                    cb(v, pick, (hp, self.maglev.snap_payload(msnap)))
+                else:
+                    cb(v, hp)
+            except MemoryError:
+                raise
+            except Exception:
+                _log.error("step classify callback failed", exc=True)
 
     def _deliver(self, batch: list, idxs, payload) -> None:
-        for (_, cb), idx in zip(batch, idxs):
+        # with the maglev plane, payload is the FusedPair's
+        # (hint_payload, maglev_payload) and a row is (verdict, pick);
+        # plain submits keep the hint-only cb(idx, hint_payload) shape
+        paired = self._pair is not None
+        hp = payload[0] if paired else payload
+        for (_, _, _, cb, want), idx in zip(batch, idxs):
+            row = np.atleast_1d(np.asarray(idx))
             try:
-                cb(int(idx), payload)
+                if want:
+                    pick = int(row[1]) if row.size > 1 else -1
+                    cb(int(row[0]), pick, payload)
+                else:
+                    cb(int(row[0]), hp)
             except MemoryError:
                 raise
             except Exception:

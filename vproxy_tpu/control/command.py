@@ -347,11 +347,16 @@ def _h_ups(app: Application, c: Command):
         out = []
         for u in app.upstreams.values():
             m = u._matcher
+            fs = m.fused_stat() if hasattr(m, "fused_stat") \
+                else {"available": False}
+            fused = (f"fused on({fs.get('kernel')},"
+                     f"{fs.get('packed_bytes', 0)}B)"
+                     if fs.get("available") else "fused off")
             out.append(
                 f"{u.alias} -> groups {len(u.handles)} backend {m.backend} "
                 f"rules {m.size()} generation {m.generation} "
                 f"table-bytes {m.published_table_bytes()} "
-                f"checksum {m.checksum():#010x}")
+                f"checksum {m.checksum():#010x} {fused}")
         return out
     if c.action in ("remove", "force-remove"):
         ups = _need(app.upstreams, c.alias, "upstream")

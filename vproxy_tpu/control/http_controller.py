@@ -92,6 +92,14 @@ def _details(app, rtype: str) -> list:
                 "generation": u._matcher.generation,
                 "tableBytes": u._matcher.published_table_bytes(),
                 "checksum": u._matcher.checksum(),
+                # fused classify+pick state (docs/perf.md fused
+                # dispatch): packed-table availability, serving kernel
+                # tier, packed device bytes — with the launch counters
+                # on /metrics this makes "one launch per batch"
+                # operator-verifiable
+                "fused": (u._matcher.fused_stat()
+                          if hasattr(u._matcher, "fused_stat")
+                          else {"available": False}),
             },
         } for a, u in app.upstreams.items()]
     if rtype == "server-group":

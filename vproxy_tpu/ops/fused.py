@@ -1,0 +1,321 @@
+"""Fused classify+pick dispatch — one launch, one memory sweep per batch.
+
+The round-8 cost model (PERF_NOTES) showed the dispatch chain — FNV
+hash, cuckoo probe, hint gather, verdict resolve, Maglev pick — riding
+~5 separate XLA dispatches per batch, so every batch paid multiple
+launch overheads and multiple passes over the tables. Pope et al.
+(MLSys'23) is the template: fixed-shape batches amortize launch
+overhead only when the per-batch work is ONE fused program, and Maglev
+(Eisenbud, NSDI'16) makes the pick table just another gather that
+belongs inside the same sweep.
+
+Two layers live here:
+
+* **Packing** (`pack_hint_table` / `pack_cidr_table`): the compiled
+  hash tables (ops/hashmatch) re-packed into int8/int32 layouts chosen
+  for a single linear sweep. The per-rule record — active flag, port,
+  host/uri kind+len, uri score — becomes ONE int32 row (`pk_meta`,
+  [r_cap, 8]) and the host+uri compare bytes ONE uint8 row
+  (`pk_bytes`, [r_cap, hw+uw]), so resolving a candidate is two row
+  gathers instead of the nine separate-array gathers the unfused
+  kernel pays. The cuckoo slot side packs the same way: (used/klen,
+  bucket_start, bucket_count) co-locate in one int32 row per slot
+  (`pk_hslot`/`pk_uslot`/`pk_cslot`), halving the probe gathers.
+  Packing is pure vectorized numpy and runs INSIDE the matcher's
+  standby compile (rules/engine.py), so packed generations publish
+  through the same double-buffered TableInstaller swap as everything
+  else.
+
+* **The fused kernel** (`fused_classify_pick` / `fused_jit`): one
+  jitted program taking the encoded query batch plus the published
+  snapshot's packed tables (hint, optional cidr/LPM, Maglev column)
+  and returning (verdict, pick[, route]) stacked [B, 2|3] — one XLA
+  launch, one d2h transfer per batch. Verdicts are bit-identical to
+  `hashmatch.hint_hash_match` (same formulas, same i32 packing
+  reduction; only the gather layout changed) and picks bit-identical
+  to `maglev._device_take` (same host-side FNV slots, same clipped
+  take). tests/test_fused.py proves both on randomized 100k-rule
+  tables.
+
+A Pallas implementation of the same contract lives in
+ops/fused_pallas.py behind a capability probe; `layout_key()` is the
+cache key every compiled-fused-fn cache must carry so a
+`VPROXY_TPU_*` knob change mid-process can never serve a stale
+compiled program (the PR-6 stale-mesh family of bug).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import cuckoo as CK
+from .hashmatch import DOT, HOST_SHIFT, _fnv32_device
+
+# Packed-table layout version: bump on ANY change to the pk_* array
+# shapes/column meanings. Folded into layout_key() so compiled-fn
+# caches (engine._fused_fn) and cross-process consumers can detect a
+# mismatch instead of gathering garbage.
+PACK_LAYOUT_V = 1
+
+
+def kernel_mode() -> str:
+    """VPROXY_TPU_FUSED_KERNEL: "auto" (pallas on capable real devices,
+    jit elsewhere), "jit" (force the CPU-valid fused jit), "pallas"
+    (force the Pallas tier — interpret-mode on CPU when
+    VPROXY_TPU_PALLAS_INTERPRET=1, else refused by the probe).
+    Re-read per call: jit statics must honor mid-process changes."""
+    return os.environ.get("VPROXY_TPU_FUSED_KERNEL", "auto")
+
+
+def layout_key() -> tuple:
+    """The key every fused-fn cache must use: packed layout version +
+    the env knobs that select a different compiled program. A knob
+    change mid-process produces a NEW key, never a stale hit."""
+    return (PACK_LAYOUT_V, kernel_mode(),
+            os.environ.get("VPROXY_TPU_PALLAS_INTERPRET", "0"))
+
+
+# ------------------------------------------------------------- packing
+
+def pack_hint_table(a: dict) -> dict:
+    """HashHintTable.arrays -> packed numpy arrays (see module doc).
+
+    Column map (pk_meta, int32 [r_cap, 8]):
+      0 active  1 port  2 host_kind  3 host_len
+      4 uri_kind  5 uri_len  6 uri_score  7 reserved
+    pk_bytes (uint8 [r_cap, hw+uw]): [0:hw] reversed host bytes,
+    [hw:] uri bytes — hw is carried statically by pk_hsplit's shape.
+    pk_hslot/pk_uslot (int32 [C, 4]): 0 klen-or--1-when-unused,
+    1 bucket_start, 2 bucket_count, 3 reserved.
+
+    Static specialization: a generation with ZERO uri rules (no
+    normal, no wildcard) can never match by uri, so the uri half of
+    the sweep — probe tables, uri byte columns, wildcard list — is
+    OMITTED from the packed dict entirely. The dict's key set is part
+    of the jit trace structure, so the compiled program for such a
+    table simply has no uri work in it (the 1M bench shape is pure
+    host rules; this is where its sweep bytes go)."""
+    r_cap = a["r_active"].shape[0]
+    hw = a["r_host"].shape[1]
+    has_uri = bool((a["r_uri_kind"] > 0).any())
+    meta = np.zeros((r_cap, 8), np.int32)
+    meta[:, 0] = a["r_active"]
+    meta[:, 1] = a["r_port"]
+    meta[:, 2] = a["r_host_kind"]
+    meta[:, 3] = a["r_host_len"]
+    meta[:, 4] = a["r_uri_kind"]
+    meta[:, 5] = a["r_uri_len"]
+    meta[:, 6] = a["r_uri_score"]
+    CK.coop_yield()  # standby-compile pacing: multi-MB memcpys below
+    by = np.concatenate([a["r_host"], a["r_uri"]], axis=1) if has_uri \
+        else np.ascontiguousarray(a["r_host"])
+    CK.coop_yield()
+
+    def slot_pack(used, klen, bs, bc):
+        s = np.zeros((used.shape[0], 4), np.int32)
+        s[:, 0] = np.where(used, klen, -1)
+        s[:, 1] = bs
+        s[:, 2] = bc
+        return s
+
+    out = {
+        "pk_meta": meta, "pk_bytes": by,
+        "pk_hsplit": np.zeros(hw, np.int8),  # hw as a static shape
+        "pk_hslot": slot_pack(a["hk_used"], a["hk_len"], a["hk_bs"],
+                              a["hk_bc"]),
+        "pk_hkey": a["hk_bytes"],
+        "hb_items": a["hb_items"], "wh_idx": a["wh_idx"],
+        "bh_iota": a["bh_iota"],
+    }
+    if has_uri:
+        out.update({
+            "pk_uslot": slot_pack(a["uk_used"], a["uk_len"], a["uk_bs"],
+                                  a["uk_bc"]),
+            "pk_ukey": a["uk_bytes"], "ub_items": a["ub_items"],
+            "wu_idx": a["wu_idx"], "bu_iota": a["bu_iota"],
+        })
+    CK.coop_yield()
+    return out
+
+
+def pack_cidr_table(a: dict) -> dict:
+    """HashCidrTable.arrays -> packed arrays. pk_cslot (int32 [CT, 4]):
+    0 used, 1 bucket_start, 2 bucket_count, 3 reserved; pk_cmeta
+    (int32 [r_cap, 4]): 0 valid, 1 min_port, 2 max_port, 3 reserved.
+    The small per-group arrays (g_*) stay as-is — they are read once
+    per batch, not per candidate."""
+    cs = np.zeros((a["s_used"].shape[0], 4), np.int32)
+    cs[:, 0] = a["s_used"]
+    cs[:, 1] = a["s_bs"]
+    cs[:, 2] = a["s_bc"]
+    CK.coop_yield()
+    cm = np.zeros((a["r_valid"].shape[0], 4), np.int32)
+    cm[:, 0] = a["r_valid"]
+    cm[:, 1] = a["min_port"]
+    cm[:, 2] = a["max_port"]
+    CK.coop_yield()
+    return {
+        "pk_cslot": cs, "pk_cmeta": cm, "s_key": a["s_key"],
+        "cb_items": a["cb_items"], "g_fam": a["g_fam"],
+        "g_mask": a["g_mask"], "g_off": a["g_off"],
+        "g_capmask": a["g_capmask"], "g_salt1": a["g_salt1"],
+        "g_salt2": a["g_salt2"], "bk_iota": a["bk_iota"],
+    }
+
+
+# ------------------------------------------------------- fused kernel
+
+def _packed_probe(slots, plen, pslot, kbytes, qbytes, iota):
+    """Byte-verified cuckoo probe against the PACKED slot rows: one
+    [B, P, 4] gather answers used+klen+bucket in a single sweep (the
+    unfused kernel pays four). Same candidate set as
+    hashmatch._probe_buckets: unused slots carry klen -1, and a valid
+    probe's plen is >= 0, so (klen == plen) subsumes the used test."""
+    k = kbytes.shape[1]
+    s = jnp.maximum(slots, 0)
+    srec = pslot[s]  # [B, P, 4] — the ONE slot gather
+    ok = (slots >= 0) & (srec[..., 0] == plen)
+    kb = kbytes[s]  # [B, P, K]
+    span = jnp.arange(k, dtype=jnp.int32)
+    eq = (kb == qbytes[:, None, :k]) | (span[None, None, :] >= plen[:, :, None])
+    ok = ok & jnp.all(eq, axis=-1)
+    start, cnt = srec[..., 1], srec[..., 2]
+    j = iota[None, None, :]
+    return jnp.where(ok[:, :, None] & (j < cnt[:, :, None]),
+                     start[:, :, None] + j, -1)
+
+
+def _hint_verdict_packed(t: dict, q: dict):
+    """hint_hash_match over the packed layout: candidate resolve is
+    TWO row gathers (pk_meta + pk_bytes) instead of nine array
+    gathers. Formula-for-formula the unfused kernel — bit-identical
+    winners (tests/test_fused.py parity)."""
+    r_cap = t["pk_meta"].shape[0]
+    b = q["hostb"].shape[0]
+    hw = t["pk_hsplit"].shape[0]
+    has_uri = "pk_uslot" in t  # static: uri-free tables compile a
+    #                            program with NO uri work (pack doc)
+
+    ch1 = _packed_probe(q["hp_slot1"], q["hp_len"], t["pk_hslot"],
+                        t["pk_hkey"], q["hostb"], t["bh_iota"])
+    ch2 = _packed_probe(q["hp_slot2"], q["hp_len"], t["pk_hslot"],
+                        t["pk_hkey"], q["hostb"], t["bh_iota"])
+    host_cand = jnp.where(ch1 >= 0, t["hb_items"][jnp.maximum(ch1, 0)], -1)
+    host_cand2 = jnp.where(ch2 >= 0, t["hb_items"][jnp.maximum(ch2, 0)], -1)
+    parts = [host_cand.reshape(b, -1), host_cand2.reshape(b, -1)]
+    if has_uri:
+        cu1 = _packed_probe(q["up_slot1"], q["up_len"], t["pk_uslot"],
+                            t["pk_ukey"], q["urib"], t["bu_iota"])
+        cu2 = _packed_probe(q["up_slot2"], q["up_len"], t["pk_uslot"],
+                            t["pk_ukey"], q["urib"], t["bu_iota"])
+        parts.append(jnp.where(
+            cu1 >= 0, t["ub_items"][jnp.maximum(cu1, 0)], -1)
+            .reshape(b, -1))
+        parts.append(jnp.where(
+            cu2 >= 0, t["ub_items"][jnp.maximum(cu2, 0)], -1)
+            .reshape(b, -1))
+    parts.append(jnp.broadcast_to(t["wh_idx"][None],
+                                  (b, t["wh_idx"].shape[0])))
+    if has_uri:
+        parts.append(jnp.broadcast_to(t["wu_idx"][None],
+                                      (b, t["wu_idx"].shape[0])))
+    cand = jnp.concatenate(parts, axis=1)  # [B, NC]
+
+    c = jnp.maximum(cand, 0)
+    meta = t["pk_meta"][c]   # [B, NC, 8] — one sweep over the records
+    by = t["pk_bytes"][c]    # [B, NC, hw+uw] — one sweep over the bytes
+    valid = (cand >= 0) & (meta[..., 0] > 0)
+
+    rp = meta[..., 1]
+    pg = (q["port"][:, None] == 0) | (rp == 0) | (q["port"][:, None] == rp)
+
+    hk, hl_ = meta[..., 2], meta[..., 3]
+    rb = by[..., :hw]
+    span = jnp.arange(hw, dtype=jnp.int32)
+    heq = jnp.all((rb == q["hostb"][:, None, :hw]) |
+                  (span[None, None, :] >= hl_[:, :, None]), axis=-1)
+    exact = heq & (hl_ == q["hlen"][:, None])
+    boundary = jnp.take_along_axis(
+        q["hostb"], jnp.clip(hl_, 0, hw - 1), axis=1)
+    suffix = heq & (hl_ < q["hlen"][:, None]) & (boundary == DOT)
+    host_level = jnp.maximum(
+        jnp.maximum(jnp.where(exact, 3, 0), jnp.where(suffix, 2, 0)),
+        jnp.where(hk == 2, 1, 0))
+    host_level = jnp.where((hk > 0) & q["has_host"][:, None], host_level, 0)
+
+    if has_uri:
+        uw = by.shape[-1] - hw
+        uk, ul = meta[..., 4], meta[..., 5]
+        ub = by[..., hw:]
+        uspan = jnp.arange(uw, dtype=jnp.int32)
+        ueq = jnp.all((ub == q["urib"][:, None, :uw]) |
+                      (uspan[None, None, :] >= ul[:, :, None]), axis=-1)
+        prefix = ueq & (ul <= q["ulen"][:, None])
+        uri_level = jnp.maximum(jnp.where(prefix, meta[..., 6], 0),
+                                jnp.where(uk == 2, 1, 0))
+        uri_level = jnp.where((uk > 0) & q["has_uri"][:, None],
+                              uri_level, 0)
+    else:
+        uri_level = 0  # no uri rules exist: nothing can score by uri
+
+    level = (host_level << HOST_SHIFT) + uri_level
+    level = jnp.where(valid & pg, level, 0)
+    from .hashmatch import _reduce_best
+    return _reduce_best(level, c, r_cap)
+
+
+def _cidr_first_packed(t: dict, addr16, fam, port):
+    """cidr_hash_match over the packed layout: slot resolve one
+    [B, G, 4] gather + the key row; rule gate one pk_cmeta row."""
+    r_cap = t["pk_cmeta"].shape[0]
+    b = addr16.shape[0]
+    masked = addr16[:, None, :] & t["g_mask"][None]  # [B, G, 16]
+    gok = (t["g_fam"][None] >= 0) & (fam[:, None] == t["g_fam"][None])
+
+    cands = []
+    for salt in (t["g_salt1"], t["g_salt2"]):
+        h = _fnv32_device(masked, salt)
+        slot = t["g_off"][None] + (
+            h.astype(jnp.int32) & t["g_capmask"][None])
+        srec = t["pk_cslot"][slot]  # [B, G, 4]
+        key = t["s_key"][slot]      # [B, G, 16]
+        ok = gok & (srec[..., 0] > 0) & jnp.all(key == masked, axis=-1)
+        start, cnt = srec[..., 1], srec[..., 2]
+        j = t["bk_iota"][None, None, :]
+        cands.append(jnp.where(ok[:, :, None] & (j < cnt[:, :, None]),
+                               start[:, :, None] + j, -1))
+    slot_cand = jnp.concatenate(cands, axis=1).reshape(b, -1)
+    cand = jnp.where(slot_cand >= 0,
+                     t["cb_items"][jnp.maximum(slot_cand, 0)], -1)
+    c = jnp.maximum(cand, 0)
+    meta = t["pk_cmeta"][c]  # [B, NC, 4]
+    valid = (cand >= 0) & (meta[..., 0] > 0)
+    if port is not None:
+        valid = valid & (meta[..., 1] <= port[:, None]) & \
+            (port[:, None] <= meta[..., 2])
+    first = jnp.min(jnp.where(valid, c, r_cap), axis=1).astype(jnp.int32)
+    return jnp.where(first < r_cap, first, -1)
+
+
+def fused_classify_pick(ht: dict, q: dict, mtab, slots,
+                        ct: Optional[dict] = None, a16=None, fam=None,
+                        port=None):
+    """THE fused program: hint verdict + Maglev pick (+ optional
+    cidr/LPM route when a packed cidr table and addr batch ride along)
+    in one compiled launch. -> int32 [B, 2] (verdict, pick) or
+    [B, 3] (verdict, pick, route). `slots` are host-side FNV Maglev
+    slots (the shared hash contract of rules/maglev.py) so the pick
+    column is bit-identical with every other pick plane."""
+    v, _level = _hint_verdict_packed(ht, q)
+    p = jnp.take(mtab, slots, mode="clip").astype(jnp.int32)
+    cols = [v, p]
+    if ct is not None:
+        cols.append(_cidr_first_packed(ct, a16, fam, port))
+    return jnp.stack(cols, axis=1)
+
+
+fused_jit = jax.jit(fused_classify_pick)

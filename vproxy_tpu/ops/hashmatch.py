@@ -109,7 +109,9 @@ def compile_hint_hash(rules: Sequence[HintRule],
     r_cap = caps.get("r_cap") or _pad_cap(n, 256)
     if n > r_cap:
         r_cap = _pad_cap(n, 256)
-    assert 4095 * (r_cap + 1) + r_cap < 2**31, "table too large for i32 packing"
+    # past _PACK_I32_MAX rules the kernel's (level, index) reduction
+    # switches from i32 packing to the two-pass form (see
+    # hint_hash_match) — no capacity assert needed anymore
 
     host_buckets: dict[bytes, list[int]] = {}
     uri_buckets: dict[bytes, list[int]] = {}
@@ -280,8 +282,15 @@ _FNV64_OFFSET_I = CK._FNV64_OFFSET_I
 # below this batch size the per-hint pure-python encoder wins: the
 # vectorized rolling-FNV pass costs ~W sequential numpy calls whose
 # per-call overhead dwarfs the math on accept-path-sized batches
-# (measured 309us numpy vs ~60us python at b=8, 20k rules)
-SMALL_ENCODE = int(os.environ.get("VPROXY_TPU_SMALL_ENCODE", "32"))
+# (measured 309us numpy vs ~60us python at b=8, 20k rules). The
+# PR-6 crossover of 32 was measured against the 5-op dispatch chain;
+# re-measured under the fused dispatch (PERF_NOTES round 12, both 20k
+# and 200k tables) the python path's advantage ends at ~28 (b=24: 268
+# vs 316us; b=28: 324 vs 328us; b=30: 328 vs 318us; b=32: 573 vs
+# 346us) — the fused launch removed enough dispatch overhead that
+# encode is a larger share of the batch, and the numpy pass amortizes
+# sooner than the old 32 default assumed.
+SMALL_ENCODE = int(os.environ.get("VPROXY_TPU_SMALL_ENCODE", "28"))
 
 
 def _encode_hint_queries_small(hints: Sequence, tab: HashHintTable,
@@ -476,6 +485,34 @@ def _probe_buckets(slots, plen, used, klen, kbytes, bs, bc, qbytes, iota):
                      start[:, :, None] + j, -1)
 
 
+# largest r_cap whose (level, index) pair still packs into one i32
+# (max level = (3 << HOST_SHIFT) + URI_MAX_SCORE = 4095)
+_PACK_I32_MAX = (2**31 - 1) // 4096 - 1
+
+
+def _reduce_best(level, c, r_cap: int):
+    """(max level, min index among level-winners) -> (idx, level).
+    Small tables keep the single-reduction i32 packing; past
+    _PACK_I32_MAX (a million-rule single table — the fused path's
+    scale tier) the packed product would overflow i32, so the same
+    winner comes from two reductions. Static branch (r_cap is a trace
+    constant): zero cost for the small case, identical winners in
+    both."""
+    if r_cap <= _PACK_I32_MAX:
+        pack = jnp.where(level > 0, level * (r_cap + 1) + (r_cap - c), 0)
+        best = jnp.max(pack, axis=1)
+        best_level = best // (r_cap + 1)
+        best_idx = r_cap - best % (r_cap + 1)
+        return jnp.where(best > 0, best_idx, -1).astype(jnp.int32), \
+            best_level.astype(jnp.int32)
+    best_level = jnp.max(level, axis=1)
+    cand = jnp.where((level == best_level[:, None]) & (level > 0), c,
+                     r_cap)
+    best_idx = jnp.min(cand, axis=1)
+    return jnp.where(best_level > 0, best_idx, -1).astype(jnp.int32), \
+        best_level.astype(jnp.int32)
+
+
 def hint_hash_match(t: dict, q: dict):
     """-> (best rule idx [B] i32 or -1, best level [B] i32).
 
@@ -546,14 +583,7 @@ def hint_hash_match(t: dict, q: dict):
 
     level = (host_level << HOST_SHIFT) + uri_level
     level = jnp.where(valid & pg, level, 0)
-
-    # (max level, min index) via i32 packing; r_cap bound asserted at compile
-    pack = jnp.where(level > 0, level * (r_cap + 1) + (r_cap - c), 0)
-    best = jnp.max(pack, axis=1)
-    best_level = best // (r_cap + 1)
-    best_idx = r_cap - best % (r_cap + 1)
-    return jnp.where(best > 0, best_idx, -1).astype(jnp.int32), \
-        best_level.astype(jnp.int32)
+    return _reduce_best(level, c, r_cap)
 
 
 # --------------------------------------------------------------- cidr side

@@ -210,6 +210,8 @@ _gen_lock = threading.Lock()
 _GENERATION = [0]
 _MATCHERS: "weakref.WeakSet" = weakref.WeakSet()
 _LAST_SERVE = [0.0]  # monotonic ts of the last serving-path read
+_LAUNCHES = [0]      # device launches on the dispatch path (per batch)
+_FUSED_DISP = [0]    # of which: fused one-launch dispatches
 
 
 def note_serving() -> None:
@@ -227,6 +229,25 @@ def serving_recent(window_s: float = 5.0) -> bool:
 
 def generation_total() -> int:
     return _GENERATION[0]
+
+
+def note_launch(n: int = 1) -> None:
+    """Count one device launch on the dispatch path (a lock-free int
+    store race can only lose a count, never corrupt — same contract as
+    the C-side counters). This is what makes the fused path's
+    one-launch-per-batch claim SCRAPE-verifiable
+    (vproxy_engine_dispatch_launches_total) instead of bench-asserted:
+    every jitted submit site increments it, so fused batches move the
+    counter by exactly 1 and the unfused chain by one per chained op."""
+    _LAUNCHES[0] += n
+
+
+def dispatch_launches_total() -> int:
+    return _LAUNCHES[0]
+
+
+def fused_dispatches_total() -> int:
+    return _FUSED_DISP[0]
 
 
 def table_bytes_total(kind: str) -> int:
@@ -409,6 +430,175 @@ def flush_installs(timeout: Optional[float] = None) -> bool:
     return True if inst is None else inst.flush(timeout)
 
 
+# --------------------------------------------- fused classify+pick entry
+#
+# ops/fused.py packs the compiled hash tables into int8/int32 layouts
+# (one meta row + one byte row per rule, one slot row per cuckoo slot)
+# and compiles the whole dispatch chain — probe, gather, verdict
+# resolve, Maglev pick, optionally the cidr/LPM walk — into ONE jitted
+# program. The packed arrays are built INSIDE the matcher's standby
+# compile below, so they publish through the same TableInstaller
+# atomic-swap as every other table: a fused reader can never pair one
+# generation's probe salts with another's packed records.
+
+def fused_enabled() -> bool:
+    """VPROXY_TPU_FUSED (default on): build packed tables on "jax"
+    matchers and serve classify+pick from the fused one-launch entry.
+    Off restores the overlapped two-dispatch chain (the A/B lever)."""
+    return os.environ.get("VPROXY_TPU_FUSED", "1") != "0"
+
+
+_FUSED_FN: dict = {}
+
+
+def _fused_fn():
+    """The compiled fused entry for the CURRENT knob state. Keyed on
+    fused.layout_key() — packed-layout version + the VPROXY_TPU_*
+    kernel knobs — NOT cached forever: a knob change mid-process must
+    select a fresh compiled program, never serve the stale one (the
+    PR-6 stale-mesh bug family; regression-tested)."""
+    from ..ops import fused as F
+    key = F.layout_key()
+    fn = _FUSED_FN.get(key)
+    if fn is None:
+        fn = F.fused_jit
+        if F.kernel_mode() in ("pallas", "auto"):
+            from ..ops import fused_pallas as FP
+            ok, why = FP.pallas_supported()
+            # "auto" requires a COMPILED probe pass: interpret mode is
+            # the bit-verify lane (~100x slower per batch), so it only
+            # serves under an explicit kernel=pallas — exporting
+            # VPROXY_TPU_PALLAS_INTERPRET=1 to verify must never flip
+            # production serving onto the interpreter
+            if ok and (F.kernel_mode() == "pallas"
+                       or not FP.interpret_forced()):
+                fn = FP.fused_classify_pick_pallas
+            elif F.kernel_mode() == "pallas":
+                _log.warn(f"VPROXY_TPU_FUSED_KERNEL=pallas but the "
+                          f"capability probe refused ({why}); serving "
+                          f"the fused jit tier")
+        _FUSED_FN[key] = fn
+    return fn
+
+
+def fused_kernel_name() -> str:
+    """Which tier the fused entry serves with right now ("jit" or
+    "pallas") — surfaced in the HTTP engine object. Reported from
+    CACHED state only: a stat read (list-detail / HTTP detail on the
+    control thread) must never run the Pallas capability probe, whose
+    first pass compiles and dispatches a kernel — the control-path
+    stall class PR-10 moved the steering rebuild to avoid. Before the
+    first fused dispatch resolves the tier, the answer is the jit
+    default."""
+    from ..ops import fused as F
+    from ..ops import fused_pallas as FP
+    fn = _FUSED_FN.get(F.layout_key())
+    if fn is not None:
+        return "pallas" if fn is FP.fused_classify_pick_pallas else "jit"
+    if F.kernel_mode() in ("pallas", "auto"):
+        probe = FP.probe_cached()
+        if probe is not None and probe[0] and \
+                (F.kernel_mode() == "pallas"
+                 or not FP.interpret_forced()):
+            return "pallas"
+    return "jit"
+
+
+def _fused_stat(fd: Optional[dict]) -> dict:
+    """Fused-dispatch state for the operator surfaces (list-detail
+    upstream / HTTP engine object) — ONE shape for both matcher kinds:
+    packed-table availability, device bytes, serving kernel tier."""
+    if fd is None:
+        return {"available": False}
+    return {"available": True, "kernel": fused_kernel_name(),
+            "packed_bytes": int(sum(getattr(v, "nbytes", 0)
+                                    for v in fd.values()))}
+
+
+def fused_dispatch(hm, hsnap: tuple, mm, msnap: tuple, hints,
+                   ips: Sequence[bytes],
+                   ports: Optional[Sequence[int]] = None,
+                   pad_to: Optional[int] = None):
+    """ONE launch answering (verdict, pick) for a batch: encoded hint
+    queries + host-side Maglev slots into the fused program against
+    one (hint, maglev) snapshot pair. Returns the async int32 [B, 2]
+    device array, or None when the fused path is unavailable for
+    these snapshots (non-"jax" backend, VPROXY_TPU_FUSED=0, or a
+    pre-fused publish) — callers fall back to the two-dispatch chain."""
+    if not hints or len(hints) != len(ips):
+        return None
+    fd = hsnap[5] if len(hsnap) > 5 else None
+    if fd is None or not hsnap[2]:
+        return None
+    mtab, mdev = msnap[0], msnap[1]
+    if mtab is None or mdev is None:
+        return None
+    note_serving()
+    q = _fused_hint_q(hsnap[0], hints, pad_to)
+    slots = _fused_slots(mtab, ips, ports, q["hostb"].shape[0])
+    fn = _fused_fn()
+    note_launch()
+    _FUSED_DISP[0] += 1
+    return fn(fd, q, mdev, slots)
+
+
+def fused_dispatch_all(hm, hsnap: tuple, cm, csnap: tuple, mm,
+                       msnap: tuple, hints, addrs: Sequence[bytes],
+                       ips: Sequence[bytes],
+                       ports: Optional[Sequence[int]] = None,
+                       pad_to: Optional[int] = None):
+    """The full fused sweep: hint verdict + cidr/LPM route + Maglev
+    pick, one launch, int32 [B, 3] (verdict, pick, route). Route
+    queries carry no ACL port gate (route-table semantics, ports=None
+    in CidrMatcher.dispatch_snap). Always the jit tier — the Pallas
+    kernel covers the (verdict, pick) serving contract; the 3-column
+    form is the bench/step-loop shape. None when either packed table
+    is missing (fallback: the op chain)."""
+    if not hints or len(hints) != len(addrs) or len(hints) != len(ips):
+        return None
+    fd = hsnap[5] if len(hsnap) > 5 else None
+    cfd = csnap[6] if len(csnap) > 6 else None
+    if fd is None or cfd is None or not hsnap[2] or not csnap[1]:
+        return None
+    mtab, mdev = msnap[0], msnap[1]
+    if mtab is None or mdev is None:
+        return None
+    note_serving()
+    q = _fused_hint_q(hsnap[0], hints, pad_to)
+    cap = q["hostb"].shape[0]
+    slots = _fused_slots(mtab, ips, ports, cap)
+    a16, fam = T.encode_ips(addrs)
+    if cap > a16.shape[0]:
+        k = cap - a16.shape[0]
+        a16 = np.concatenate([a16, np.zeros((k,) + a16.shape[1:],
+                                            a16.dtype)])
+        fam = np.concatenate([fam, np.full(k, -1, fam.dtype)])
+    from ..ops import fused as F
+    note_launch()
+    _FUSED_DISP[0] += 1
+    return F.fused_jit(fd, q, mdev, slots, cfd, a16, fam, None)
+
+
+def _fused_hint_q(tab, hints, pad_to: Optional[int]) -> dict:
+    q = H.encode_hint_queries(hints, tab, pad_to=pad_to or 0)
+    if pad_to and q["hostb"].shape[0] < pad_to:
+        q = _pad_hint_q(q, pad_to, _PAD_CUCKOO)
+    return q
+
+
+def _fused_slots(mtab, ips, ports, cap: int) -> np.ndarray:
+    """Host-side Maglev slots (maglev.flow_slots — THE one copy of the
+    slot-hash contract, so fused picks are bit-identical to every
+    other pick plane); pad rows ride slot 0 and are sliced off by the
+    caller."""
+    from .maglev import flow_slots
+    slots = flow_slots(len(mtab), ips, ports)
+    if cap > len(slots):
+        slots = np.concatenate([slots, np.zeros(cap - len(slots),
+                                                np.int64)])
+    return slots
+
+
 class HintMatcher:
     """Device-backed (or host-fallback) Upstream/DNS hint matcher."""
 
@@ -541,10 +731,18 @@ class HintMatcher:
         if len(self._rules) > SMALL_TABLE:
             from .index import HintIndex
             idx = HintIndex(self._rules)
+        # packed fused-dispatch tables (ops/fused.py): built in THIS
+        # standby compile and published in the SAME atomic tuple swap —
+        # the fused reader's generation consistency is the pub tuple's
+        fused_dev = None
+        if self.backend == "jax" and fused_enabled():
+            from ..ops import fused as F
+            fused_dev = _to_device(F.pack_hint_table(self._tab.arrays))
         _sync_standby(self._dev)
+        _sync_standby(fused_dev)
         time.sleep(0)  # preemption point between compile and publish
         self._pub = (self._tab, self._dev, list(self._rules), self._payload,
-                     idx)
+                     idx, fused_dev)
         self.generation += 1
         with _gen_lock:
             _GENERATION[0] += 1
@@ -557,8 +755,14 @@ class HintMatcher:
 
     def submit(self, q: dict):
         """Dispatch an encoded batch; returns the device array (async)."""
+        note_launch()
         idx, _ = H.hint_hash_jit(self._dev, q)
         return idx
+
+    def fused_stat(self) -> dict:
+        """See engine._fused_stat — packed hint-table state."""
+        pub = self._pub
+        return _fused_stat(pub[5] if len(pub) > 5 else None)
 
     def match(self, hints: Sequence[Hint]) -> np.ndarray:
         """-> int32 [B] matched rule index, -1 for none."""
@@ -646,14 +850,14 @@ class HintMatcher:
         tab, dev, rules = snap[0], snap[1], snap[2]
         if not rules or not hints:
             return np.full(len(hints), -1, np.int32)
+        note_launch()  # every branch below is one device dispatch
         if self.backend == "jax":
-            # small batches encode straight into the padded bucket (the
-            # per-hint python path); big ones encode the real rows then
-            # array-pad with invalid probes
-            q = H.encode_hint_queries(hints, tab, pad_to=pad_to or 0)
-            if pad_to and q["hostb"].shape[0] < pad_to:
-                q = _pad_hint_q(q, pad_to, _PAD_CUCKOO)
-            idx, _ = H.hint_hash_jit(dev, q)
+            # ONE copy of the encode+pad idiom, shared with the fused
+            # entry: small batches encode straight into the padded
+            # bucket (the per-hint python path); big ones encode the
+            # real rows then array-pad with invalid probes
+            idx, _ = H.hint_hash_jit(dev,
+                                     _fused_hint_q(tab, hints, pad_to))
             return idx
         if self.backend == "jax-fp":
             from ..ops import fphash as F
@@ -764,10 +968,12 @@ class CidrMatcher:
         return int(sum(getattr(v, "nbytes", 0) for v in dev.values()))
 
     def _recompile(self) -> None:
+        hash_arrays = None  # "jax" backend: source for the packed build
         if self.backend == "jax":
             tab = H.compile_cidr_hash(self._nets, acl=self._acl, caps=self._caps)
             self._caps = tab.caps
             self._dev = _to_device(tab.arrays)
+            hash_arrays = tab.arrays
         elif self.backend == "jax-fp":
             from ..ops import fphash as F
             try:
@@ -808,14 +1014,26 @@ class CidrMatcher:
         if len(self._nets) > SMALL_TABLE:  # every backend: see HintMatcher
             from .index import CidrIndex
             idx = CidrIndex(self._nets, acl=self._acl)
+        # packed fused-dispatch tables: same standby-build + atomic
+        # pub-swap contract as HintMatcher._recompile
+        fused_dev = None
+        if hash_arrays is not None and fused_enabled():
+            from ..ops import fused as F
+            fused_dev = _to_device(F.pack_cidr_table(hash_arrays))
         _sync_standby(self._dev)
+        _sync_standby(fused_dev)
         time.sleep(0)  # preemption point between compile and publish
         self._pub = (self._dev, list(self._nets),
                      None if self._acl is None else list(self._acl),
-                     self._payload, self._tab, idx)
+                     self._payload, self._tab, idx, fused_dev)
         self.generation += 1
         with _gen_lock:
             _GENERATION[0] += 1
+
+    def fused_stat(self) -> dict:
+        """See engine._fused_stat — packed cidr-table state."""
+        pub = self._pub
+        return _fused_stat(pub[6] if len(pub) > 6 else None)
 
     def match(self, addrs: Sequence[bytes],
               ports: Optional[Sequence[int]] = None) -> np.ndarray:
@@ -902,6 +1120,7 @@ class CidrMatcher:
         dev, nets, acl = snap[0], snap[1], snap[2]
         if not nets or not addrs:
             return np.full(len(addrs), -1, np.int32)
+        note_launch()  # every branch below is one device dispatch
         a16, fam = T.encode_ips(addrs)
         # route tables (acl=None) have zeroed port-range columns: the port
         # gate must be skipped entirely or every port>0 query misses

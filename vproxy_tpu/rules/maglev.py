@@ -72,6 +72,17 @@ def flow_hash(ip: bytes, port: Optional[int] = None) -> int:
     return fnv64(ip + bytes((port >> 8 & 0xFF, port & 0xFF)))
 
 
+def flow_slots(m: int, ips: Sequence[bytes],
+               ports: Optional[Sequence[int]] = None) -> np.ndarray:
+    """Host-side Maglev table slots for a batch — THE one copy of the
+    slot-hash contract every pick plane (device gather, fused program,
+    host pick) derives from; a per-element None port is source
+    affinity. -> int64 [len(ips)]."""
+    return np.fromiter(
+        (flow_hash(ip, None if ports is None else ports[i]) % m
+         for i, ip in enumerate(ips)), np.int64, len(ips))
+
+
 def _turns(weights: Sequence[int]) -> list[int]:
     """Weighted turn order for the fill loop: the reference's
     subtract-sum WRR sequence (components/lanes._wrr_seq semantics),
@@ -344,10 +355,9 @@ class MaglevMatcher:
         tab, dev = snap[0], snap[1]
         if tab is None or not snap[2] or not len(ips):
             return np.full(len(ips), -1, np.int32)
-        m = len(tab)
-        slots = np.fromiter(
-            (flow_hash(ip, None if ports is None else ports[i]) % m
-             for i, ip in enumerate(ips)), np.int64, len(ips))
+        slots = flow_slots(len(tab), ips, ports)
+        from . import engine as E
+        E.note_launch()
         return _device_take(dev, slots)
 
     def match(self, ips: Sequence[bytes],
@@ -358,14 +368,24 @@ class MaglevMatcher:
 def classify_and_pick(hint_matcher, maglev: MaglevMatcher, hints,
                       ips: Sequence[bytes],
                       ports: Optional[Sequence[int]] = None):
-    """One batched dispatch answering BOTH questions: match verdicts
-    from the hint matcher and backend picks from the maglev table, each
-    against its own atomic snapshot, submitted back-to-back so the two
-    device round trips overlap (the async-submit idiom of the service
-    dispatcher). -> (verdicts int32[B], picks int32[B], hint_payload,
-    maglev_payload)."""
+    """ONE batched dispatch answering BOTH questions: match verdicts
+    from the hint matcher and backend picks from the maglev table
+    against one atomic snapshot pair. On a "jax" matcher with packed
+    tables published (the default) this is the FUSED one-launch
+    program (rules/engine.fused_dispatch — PERF_NOTES round 12); other
+    backends keep the pre-r12 overlapped two-dispatch submit. ->
+    (verdicts int32[B], picks int32[B], hint_payload, maglev_payload)."""
+    from . import engine as E
     hsnap = hint_matcher.snapshot()
     msnap = maglev.snapshot()
+    out = E.fused_dispatch(hint_matcher, hsnap, maglev, msnap, hints,
+                           ips, ports)
+    if out is not None:
+        arr = np.asarray(out)[: len(hints)]
+        return (np.ascontiguousarray(arr[:, 0]),
+                np.ascontiguousarray(arr[:, 1]),
+                hint_matcher.snap_payload(hsnap),
+                maglev.snap_payload(msnap))
     if getattr(hint_matcher, "backend", None) == "host":
         v = np.array([hint_matcher.oracle_snap(hsnap, h) for h in hints],
                      np.int32)
@@ -374,3 +394,85 @@ def classify_and_pick(hint_matcher, maglev: MaglevMatcher, hints,
     p = maglev.dispatch_snap(msnap, ips, ports)       # overlaps the first
     return (np.asarray(v), np.asarray(p),
             hint_matcher.snap_payload(hsnap), maglev.snap_payload(msnap))
+
+
+class FusedPair:
+    """A (HintMatcher, MaglevMatcher) pair presented through the
+    matcher interface the dispatch consumers speak (ClassifyService,
+    cluster StepLoop): snapshot() is the atomic snapshot PAIR,
+    dispatch_snap() is the fused one-launch (verdict, pick) batch, and
+    index_snap() is the host fast lane (O(probes) hint index + O(1)
+    maglev table read) for inline lone queries and degraded serving.
+    Payloads ride as (hint_payload, maglev_payload)."""
+
+    def __init__(self, hint_matcher, maglev: MaglevMatcher):
+        self.hm = hint_matcher
+        self.mm = maglev
+
+    @property
+    def backend(self) -> str:
+        return self.hm.backend
+
+    def size(self) -> int:
+        return self.hm.size()
+
+    @property
+    def generation(self) -> int:
+        return self.hm.generation + self.mm.generation
+
+    def snapshot(self) -> tuple:
+        return (self.hm.snapshot(), self.mm.snapshot())
+
+    @staticmethod
+    def snap_payload(snap: tuple):
+        hsnap, msnap = snap
+        return (hsnap[3], msnap[3])
+
+    def index_snap(self, snap: tuple, payload: tuple) -> tuple:
+        """(verdict, pick) from the host planes — the same winners as
+        the fused program (index parity is tested at the matcher
+        level; pick parity is the shared FNV contract)."""
+        hsnap, msnap = snap
+        hint, ip, port = payload
+        return (self.hm.index_snap(hsnap, hint),
+                self.mm.pick_snap(msnap, ip, port))
+
+    def dispatch_snap(self, snap: tuple, payloads, pad_to=None,
+                      sync: bool = True):
+        """One fused launch for a batch of (hint, ip, port) payloads;
+        async [cap, 2] device array. Falls back to the overlapped
+        two-dispatch chain (host-side stack) when the fused path is
+        unavailable for this snapshot."""
+        from . import engine as E
+        hsnap, msnap = snap
+        hints = [p[0] for p in payloads]
+        ips = [p[1] for p in payloads]
+        ports = [p[2] for p in payloads]
+        if all(p is None for p in ports):
+            ports = None
+        out = E.fused_dispatch(self.hm, hsnap, self.mm, msnap, hints,
+                               ips, ports, pad_to=pad_to)
+        if out is not None:
+            return out
+        v = self.hm.dispatch_snap(hsnap, hints, pad_to=pad_to,
+                                  sync=sync)
+        p = self.mm.dispatch_snap(msnap, ips, ports)
+        return _LazyPairRows(v, p, len(hints))
+
+
+class _LazyPairRows:
+    """FusedPair's unfused-fallback result: both dispatches are already
+    submitted (overlapped, async); the d2h sync happens when the
+    CONSUMER np.asarray()s — preserving the service dispatcher's
+    double-buffering (submit batch k+1 before pulling k) exactly like
+    the fused path's async device array does."""
+
+    def __init__(self, v, p, n: int):
+        self._v, self._p, self._n = v, p, n
+
+    def __array__(self, dtype=None, copy=None):
+        n = self._n
+        out = np.stack([np.asarray(self._v)[:n].astype(np.int32),
+                        np.asarray(self._p)[:n].astype(np.int32)],
+                       axis=1)
+        return out if dtype is None else out.astype(dtype)

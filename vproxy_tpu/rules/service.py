@@ -275,6 +275,19 @@ class ClassifyService:
         for none. port=None skips ACL port-range gating entirely."""
         self._submit("cidr", matcher, (addr, port), cb, loop)
 
+    def submit_classify_pick(self, pair, hint: Hint, ip: bytes,
+                             port: Optional[int],
+                             cb: Callable[[int, int, object], None],
+                             loop=None) -> None:
+        """Queue one fused classify+pick against a maglev.FusedPair:
+        cb(verdict_idx, pick_idx, (hint_payload, maglev_payload)).
+        Micro-batches ride the fused ONE-launch program
+        (rules/engine.fused_dispatch); lone queries take the inline
+        host lane (hint index + O(1) maglev read), same fast-lane
+        policy as plain hint submits. port=None = source affinity
+        (the shared Maglev hash contract)."""
+        self._submit("cpick", pair, (hint, ip, port), cb, loop)
+
     def _submit(self, kind: str, matcher, payload, cb, loop) -> None:
         inline = False
         with self._cv:
@@ -344,7 +357,8 @@ class ClassifyService:
         big = (matcher.size() > SMALL_TABLE
                and getattr(matcher, "backend", "host") != "host")
         try:
-            if kind == "hint":
+            if kind in ("hint", "cpick"):
+                # cpick: the FusedPair host lane -> (verdict, pick)
                 i = matcher.index_snap(snap, payload)
             else:
                 i = matcher.index_snap(snap, payload[0], payload[1])
@@ -353,7 +367,7 @@ class ClassifyService:
         except Exception:
             _log.error("inline classify failed; delivering no-match",
                        exc=True)
-            i = -1
+            i = (-1, -1) if kind == "cpick" else -1
         dt = time.monotonic() - t0
         st = self.stats
         with st.lock:
@@ -371,16 +385,27 @@ class ClassifyService:
                     self._probe_last = now
             if probe and self.device_ok():
                 self._spawn_probe(kind, matcher, payload)
-        i = int(i)
         pl = matcher.snap_payload(snap)
+        if kind == "cpick":
+            v, p = int(i[0]), int(i[1])
 
-        def run(cb=cb, i=i, pl=pl) -> None:
-            try:
-                cb(i, pl)
-            except MemoryError:
-                raise
-            except Exception:
-                _log.error("classify callback failed", exc=True)
+            def run(cb=cb, v=v, p=p, pl=pl) -> None:
+                try:
+                    cb(v, p, pl)
+                except MemoryError:
+                    raise
+                except Exception:
+                    _log.error("classify callback failed", exc=True)
+        else:
+            i = int(i)
+
+            def run(cb=cb, i=i, pl=pl) -> None:
+                try:
+                    cb(i, pl)
+                except MemoryError:
+                    raise
+                except Exception:
+                    _log.error("classify callback failed", exc=True)
 
         if loop is None or not loop.run_on_loop(run):
             run()
@@ -482,7 +507,8 @@ class ClassifyService:
                         _log.error("classify dispatch failed; delivering "
                                    "no-match to batch", exc=True)
                         try:
-                            self._deliver(part, [-1] * len(part))
+                            self._deliver(part, [-1] * len(part),
+                                          kind=kind)
                         except MemoryError:
                             raise
                         except Exception:
@@ -564,7 +590,7 @@ class ClassifyService:
         if lone_big:
             self._note_lone_latency("oracle", time.monotonic() - t0)
         self.stats.bump("oracle_queries", n)
-        self._deliver(reqs, idxs, matcher.snap_payload(snap))
+        self._deliver(reqs, idxs, matcher.snap_payload(snap), kind=kind)
         return None
 
     def _finish_guarded(self, inf: "_Inflight") -> None:
@@ -580,7 +606,8 @@ class ClassifyService:
             _log.error("classify finish failed; delivering no-match "
                        "to batch", exc=True)
             try:
-                self._deliver(inf.reqs, [-1] * len(inf.reqs))
+                self._deliver(inf.reqs, [-1] * len(inf.reqs),
+                              kind=inf.kind)
             except MemoryError:
                 raise
             except Exception:
@@ -612,7 +639,8 @@ class ClassifyService:
             self.stats.bump("oracle_queries", n)
         try:
             self._deliver(inf.reqs, idxs,
-                          inf.matcher.snap_payload(inf.snap))
+                          inf.matcher.snap_payload(inf.snap),
+                          kind=inf.kind)
         except MemoryError:
             raise
         except Exception:
@@ -648,7 +676,9 @@ class ClassifyService:
         # 3.4ms sync vs 5.9ms async — async halves the batch size)
         sync = getattr(matcher, "backend", "host") in (
             "jax-sharded", "jax-fp-sharded")
-        if kind == "hint":
+        if kind in ("hint", "cpick"):
+            # cpick is the FusedPair's matcher interface: the same
+            # dispatch_snap call, ONE launch answering verdicts AND picks
             return matcher.dispatch_snap(snap, [r.payload for r in reqs],
                                          pad_to=cap, sync=sync)
         addrs = [r.payload[0] for r in reqs]
@@ -668,29 +698,45 @@ class ClassifyService:
         """Host-served batch (device down / host path): rides the
         snapshot's O(probes) index — same winner as the linear oracle
         (rules/index.py parity tests), O(table) cheaper per query."""
-        if kind == "hint":
+        if kind in ("hint", "cpick"):
             return [matcher.index_snap(snap, r.payload) for r in reqs]
         return [matcher.index_snap(snap, r.payload[0], r.payload[1])
                 for r in reqs]
 
-    def _deliver(self, reqs: list[_Req], idxs, payload=None) -> None:
-        """cb(idx) or cb(idx, payload) — payload is the matcher-owner's
-        object versioned with the table generation that served the batch
-        (None when the owner didn't register one). Callbacks run on the
-        submitting loop; if that loop is gone, inline on this thread so
-        cleanup (closing an accepted fd) still happens."""
+    def _deliver(self, reqs: list[_Req], idxs, payload=None,
+                 kind: str = "hint") -> None:
+        """cb(idx, payload) — or cb(verdict, pick, payload) for cpick
+        batches, where a row is the fused program's (verdict, pick)
+        pair (a scalar row is an error fill: both -1). payload is the
+        matcher-owner's object versioned with the table generation that
+        served the batch (None when the owner didn't register one).
+        Callbacks run on the submitting loop; if that loop is gone,
+        inline on this thread so cleanup (closing an accepted fd)
+        still happens."""
         now = time.monotonic()
         for r, idx in zip(reqs, idxs):
             self.stats.record_latency(now - r.t0)
-            i = int(idx)
+            if kind == "cpick":
+                v, p = (int(idx[0]), int(idx[1])) if np.ndim(idx) \
+                    else (int(idx), int(idx))
 
-            def run(cb=r.cb, i=i) -> None:
-                try:
-                    cb(i, payload)
-                except MemoryError:
-                    raise
-                except Exception:
-                    _log.error("classify callback failed", exc=True)
+                def run(cb=r.cb, v=v, p=p) -> None:
+                    try:
+                        cb(v, p, payload)
+                    except MemoryError:
+                        raise
+                    except Exception:
+                        _log.error("classify callback failed", exc=True)
+            else:
+                i = int(idx)
+
+                def run(cb=r.cb, i=i) -> None:
+                    try:
+                        cb(i, payload)
+                    except MemoryError:
+                        raise
+                    except Exception:
+                        _log.error("classify callback failed", exc=True)
 
             if r.loop is None or not r.loop.run_on_loop(run):
                 run()

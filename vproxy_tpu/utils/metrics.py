@@ -317,6 +317,17 @@ class GlobalInspection:
                 "vproxy_engine_table_bytes",
                 lambda kind=kind: self._engine_table_bytes(kind),
                 matcher=kind)
+        # fused-dispatch accounting (rules/engine.py note_launch): total
+        # device launches on the dispatch path and how many batches rode
+        # the fused one-launch program — the scrape-verifiable form of
+        # the "one launch per batch" claim (docs/perf.md fused section):
+        # on a fused-only load the two counters move in lockstep
+        self.registry.gauge_f("vproxy_engine_dispatch_launches_total",
+                              lambda: self._engine_stat(
+                                  "dispatch_launches_total"))
+        self.registry.gauge_f("vproxy_engine_fused_dispatches_total",
+                              lambda: self._engine_stat(
+                                  "fused_dispatches_total"))
         # cluster plane (vproxy_tpu/cluster): fleet membership, rule
         # generation convergence, and the step-synchronized dispatch
         # clock — all 0 until a ClusterNode boots
@@ -356,6 +367,12 @@ class GlobalInspection:
         import sys  # scrape must not force a jax import
         eng = sys.modules.get("vproxy_tpu.rules.engine")
         return 0.0 if eng is None else float(eng.table_bytes_total(kind))
+
+    @staticmethod
+    def _engine_stat(name: str) -> float:
+        import sys  # scrape must not force a jax import
+        eng = sys.modules.get("vproxy_tpu.rules.engine")
+        return 0.0 if eng is None else float(getattr(eng, name)())
 
     @staticmethod
     def _cluster_stat(key: str) -> float:
