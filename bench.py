@@ -1826,6 +1826,41 @@ def _run_maglev_stage(timeout):
     return {k: rep[k] for k in keys if k in rep}
 
 
+def _run_trace_stage(timeout):
+    """bench_host.py --trace in a CPU-env subprocess: the request-
+    tracing round (docs/observability.md). The FULL report — per-stage
+    attribution table, slowest traces with spans, the sampling-off
+    zero-overhead A/B — is the committed BENCH trace artifact; the
+    orchestrator folds the headline gates into the round so every
+    future BENCH carries the attribution table."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_trace.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(f"# === stage trace (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--trace"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "trace", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage trace: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    keys = ("trace_overhead_off_vs_absent", "trace_overhead_pass",
+            "trace_overhead_sampled_vs_off", "trace_reconcile_lane",
+            "trace_reconcile_py", "trace_reconcile_pass",
+            "trace_stage_table", "trace_c_spans", "trace_c_ring_drops",
+            "trace_stitched", "trace_install_phases", "trace_error")
+    return {k: rep[k] for k in keys if k in rep}
+
+
 def _note_phase(phase_file, phase, seconds, **detail):
     """Orchestrator-side phase evidence (same stream the children write):
     backoff sleeps and abandonments become visible, dated records in the
@@ -2043,6 +2078,10 @@ def orchestrate():
     result.update(_run_fused_stage(
         float(os.environ.get("BENCH_FUSED_TIMEOUT", "900"))))
     publish(result)
+    # request tracing: per-stage attribution table + zero-overhead gate
+    result.update(_run_trace_stage(
+        float(os.environ.get("BENCH_TRACE_TIMEOUT", "300"))))
+    publish(result)
     result["phases"] = _read_phases(phase_file)
     # complete: disarm the handler so a late SIGTERM can't emit a second
     # (or interleaved) headline line after this one
@@ -2067,6 +2106,10 @@ if __name__ == "__main__":
     elif "--maglev" in sys.argv:  # manual: just the maglev stage
         print(json.dumps(_run_maglev_stage(
             float(os.environ.get("BENCH_MAGLEV_TIMEOUT", "300")))))
+        sys.exit(0)
+    elif "--trace" in sys.argv:  # manual: just the tracing stage
+        print(json.dumps(_run_trace_stage(
+            float(os.environ.get("BENCH_TRACE_TIMEOUT", "300")))))
         sys.exit(0)
     elif "--fused" in sys.argv:  # manual: the fused stage in-process
         from vproxy_tpu.utils.jaxenv import force_cpu

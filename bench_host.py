@@ -394,6 +394,296 @@ def run_maglev():
     return 0 if ok else 1
 
 
+def run_trace():
+    """`--trace`: the request-tracing rows (ISSUE 12,
+    docs/observability.md).
+
+    1. **zero-overhead gate** — interleaved median-of-3 short-conn A/B
+       on the lanes path: sampling knob ABSENT (module default) vs
+       explicitly OFF (configure(0)) must land within noise — the
+       knob-off branch is the only cost tracing adds to an unsampled
+       build. A sampled (1-in-8) row rides along for honesty.
+    2. **attribution capture** — sample=1 over BOTH accept planes (C
+       lanes and the python path) plus a standby table install under
+       that load: per-stage p50/p99 table, the slowest traces with
+       full spans, and the reconciliation of per-stage sums against
+       each trace's end-to-end time (the "stages account for the
+       latency" gate).
+
+    The artifact is the committed BENCH_r13 trace round."""
+    conns = _env_int("HOSTBENCH_CONNS", 32)
+    secs = float(os.environ.get("HOSTBENCH_SECS", "4"))
+    lanes_n = _env_int("HOSTBENCH_LANES", 4)
+    build_tool()
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                   ServerGroup)
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.net import vtl as _v
+    from vproxy_tpu.utils import trace as TR
+
+    result = {"trace_conns": conns, "trace_secs": secs,
+              "trace_lanes": lanes_n,
+              "trace_native": _v.trace_supported()}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(out_path + ".tmp", out_path)
+
+    procs = []
+    lb = None
+    elg = None
+    groups = []
+    try:
+        p, bport = start_server()
+        procs.append(p)
+        elg = EventLoopGroup("w", 4)
+        hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1, down=2)
+        g = ServerGroup("g", elg, hc, "wrr")
+        groups.append(g)
+        g.add("b0", "127.0.0.1", bport, weight=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not any(s.healthy for s in g.servers):
+            time.sleep(0.05)
+        if not any(s.healthy for s in g.servers):
+            result["trace_error"] = "backend never became healthy"
+            flush()
+            raise RuntimeError(result["trace_error"])
+        ups = Upstream("u")
+        ups.add(g)
+
+        # ---- 1. zero-overhead gate (absent vs off vs sampled) -------
+        # "absent" and "off" are the SAME branch by construction (the
+        # env unset and configure(0) both leave SAMPLE=0) — the A/B is
+        # the proof plus a noise-floor calibration. Short-conn rps on
+        # this sandboxed kernel bursts ±4x with ambient load, so the
+        # discipline is PAIRED ratios with alternating order (position
+        # bias cancels) and the median over 5 pairs.
+        lb = TcpLB("lb-trace", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=lanes_n)
+        lb.start()
+        result["trace_lane_engine"] = (lb.lanes.engine()
+                                       if lb.lanes is not None else "off")
+        run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+        rep_secs = max(2.0, secs / 2)
+
+        def _paired_ratios(knob_a, knob_b, reps=5):
+            # ratio = side_b / side_a per rep, order alternating
+            ratios, raw = [], []
+            for rep in range(reps):
+                sides = [("a", knob_a), ("b", knob_b)]
+                if rep % 2:
+                    sides.reverse()
+                rr = {}
+                for name, knob in sides:
+                    TR.configure(knob)
+                    time.sleep(0.5)  # settle: drain the accept burst
+                    rr[name] = run_client(lb.bind_port, conns, rep_secs,
+                                          1, short=True)["rps"]
+                raw.append(rr)
+                ratios.append(rr["b"] / max(1.0, rr["a"]))
+            ratios.sort()
+            return ratios[len(ratios) // 2], raw
+
+        off_vs_absent, raw1 = _paired_ratios(0, 0)
+        sampled_vs_off, raw2 = _paired_ratios(0, 8)
+        TR.configure(0)
+        result["trace_overhead_off_vs_absent"] = round(off_vs_absent, 3)
+        result["trace_overhead_sampled_vs_off"] = round(
+            sampled_vs_off, 3)
+        result["trace_overhead_pairs"] = {"off_vs_absent": raw1,
+                                          "sampled_vs_off": raw2}
+        # within the sandboxed kernel's same-config noise band (the
+        # r09/r11 interleaved runs measured ±15% single-sample bounce;
+        # the median-of-5 paired ratio tightens that, but the honest
+        # gate stays generous)
+        result["trace_overhead_pass"] = bool(
+            0.8 <= off_vs_absent <= 1.25)
+        flush()
+
+        # ---- 2. attribution capture (sample=1, both planes) ---------
+        # per-phase snapshots: the process buffer is bounded (512
+        # traces), so each load phase is captured and reset before the
+        # next would evict it; the attribution table merges all phases
+        captured: list = []  # (phase, [trace dicts with spans])
+
+        def snap_phase(name):
+            entries = [dict(t, spans=TR.get_trace(t["trace"]))
+                       for t in TR.summaries(last=0)]
+            captured.append((name, entries))
+            TR.reset()
+            return entries
+
+        TR.reset()
+        TR.configure(1)
+        # widen the trace buffer for the capture: sample=1 at full
+        # short-conn load generates traces faster than the production
+        # bound (512) holds, and the rare install trace must not lose
+        # its slot to the thousandth connection
+        prev_max = TR.MAX_TRACES
+        TR.MAX_TRACES = 8192
+        run_client(lb.bind_port, conns, rep_secs, 1, short=True)
+        # a standby install UNDER that load: compile/upload/swap spans
+        # bracketing unstalled dispatches (the TableInstaller contract)
+        from vproxy_tpu.rules.engine import HintMatcher
+        from vproxy_tpu.rules.ir import HintRule
+        m = HintMatcher([HintRule(host="seed.example.com")],
+                        backend="jax")
+        inst = threading.Thread(target=lambda: m.set_rules(
+            [HintRule(host=f"h{i}.trace.example.com")
+             for i in range(2000)]), daemon=True)
+        inst.start()
+        run_client(lb.bind_port, conns, rep_secs, 1, short=True)
+        inst.join(60)
+        lb.stop()  # lane threads drain their span rings on shutdown
+        lb = None
+        lane_entries = snap_phase("lane")
+        install_spans = [s for t in lane_entries for s in t["spans"]
+                         if s["plane"] == "install"]
+        result["trace_install_phases"] = sorted(
+            {s["span"] for s in install_spans})
+        result["trace_install_trace"] = install_spans
+
+        # the python accept plane: same load, lanes off
+        lb = TcpLB("lb-trace-py", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=0)
+        lb.start()
+        run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+        run_client(lb.bind_port, conns, rep_secs, 1, short=True)
+        lb.stop()
+        lb = None
+        time.sleep(0.5)
+        snap_phase("py")
+
+        # the stitched cross-plane trace: a lanes LB whose non-trivial
+        # ACL compiles an EMPTY lane entry — every accept begins its
+        # trace in C (accept + punt spans) and the python path
+        # CONTINUES it through acl/classify/pick/connect/splice
+        from vproxy_tpu.components.secgroup import SecurityGroup
+        from vproxy_tpu.rules.ir import AclRule, Proto
+        from vproxy_tpu.utils.ip import Network
+        sg = SecurityGroup("trace-acl", default_allow=False)
+        sg.add_rule(AclRule("lo", Network.parse("127.0.0.0/8"),
+                            Proto.TCP, 1, 65535, True))
+        lb = TcpLB("lb-trace-stitch", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=lanes_n, security_group=sg)
+        lb.start()
+        run_client(lb.bind_port, min(conns, 8), 2.0, 1, short=True)
+        lb.stop()
+        lb = None
+        time.sleep(1.0)
+        TR.configure(0)
+        stitch_entries = snap_phase("stitched")
+        TR.MAX_TRACES = prev_max
+
+        def _reconcile(entries):
+            """Per complete trace: sum of stage durations vs its own
+            end-to-end window — the stages must ACCOUNT for the
+            latency, not decorate it. Classified by path: pure lane /
+            pure python / stitched (a sampled punt that began in C and
+            finished in python — its gap IS the punt handoff)."""
+            recon = {"lane": [], "py": [], "stitched": []}
+            for t in entries:
+                spans = t["spans"]
+                if "close" not in {s["span"] for s in spans}:
+                    continue  # still in flight at capture end
+                has_lane = any(s["plane"] == "lane" for s in spans)
+                has_py = any(s["plane"] == "accept" for s in spans)
+                path = ("stitched" if has_lane and has_py
+                        else "lane" if has_lane else "py")
+                t0 = min(s["t_ns"] for s in spans)
+                t1 = max(s["t_ns"] + s["dur_ns"] for s in spans)
+                stage_sum = sum(
+                    s["dur_ns"] for s in spans
+                    if s["span"] in ("accept", "route_pick", "connect",
+                                     "splice", "acl", "backend_pick"))
+                if t1 > t0:
+                    recon[path].append(stage_sum / (t1 - t0))
+            out = {}
+            for path, ratios in recon.items():
+                if ratios:
+                    ratios.sort()
+                    out[path] = {
+                        "n": len(ratios),
+                        "median": round(ratios[len(ratios) // 2], 3),
+                        "min": round(ratios[0], 3),
+                        "max": round(ratios[-1], 3)}
+            return out
+
+        all_entries = [t for _, entries in captured for t in entries]
+        for path, rec in _reconcile(all_entries).items():
+            result[f"trace_reconcile_{path}"] = rec
+        # the per-stage attribution table over every captured phase
+        by: dict = {}
+        for t in all_entries:
+            for s in t["spans"]:
+                by.setdefault(f"{s['plane']}/{s['span']}", []).append(
+                    s["dur_ns"] / 1000.0)
+        result["trace_stage_table"] = {
+            k: {"n": len(v),
+                "p50_us": round(sorted(v)[len(v) // 2], 1),
+                "p99_us": round(sorted(v)[min(len(v) - 1,
+                                              (len(v) * 99) // 100)], 1)}
+            for k, v in sorted(by.items())}
+        worst = sorted(all_entries, key=lambda t: t["total_us"],
+                       reverse=True)[:5]
+        result["slowest_traces"] = worst
+        result["trace_stitched"] = sum(
+            1 for t in stitch_entries if len(t["planes"]) > 1)
+        stitched = [t for t in stitch_entries
+                    if "lane" in t["planes"] and "accept" in t["planes"]]
+        if stitched:
+            result["trace_stitched_example"] = max(
+                stitched, key=lambda t: len(t["planes"]))
+
+        spans_c, drops_c = _v.trace_counters()
+        result["trace_c_spans"] = spans_c
+        result["trace_c_ring_drops"] = drops_c
+        result["trace_py_drops"] = TR.py_dropped_total()
+        # gate: lane and python stages each cover >=90% of end-to-end
+        # at the median (the residue is real scheduling gap time; far
+        # under would mean a stage went missing). The stitched path is
+        # reported, not gated: its gap IS the punt-handoff queue time.
+        result["trace_reconcile_pass"] = bool(
+            result.get("trace_reconcile_lane", {}).get("median", 0) >= 0.9
+            and result.get("trace_reconcile_py", {}).get("median", 0)
+            >= 0.9)
+        flush()
+    finally:
+        if lb is not None:
+            try:
+                lb.stop()
+            except Exception:
+                pass
+        for g_ in groups:
+            try:
+                g_.close()
+            except Exception:
+                pass
+        if elg is not None:
+            try:
+                elg.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(json.dumps(result))
+    flush()
+    ok = result.get("trace_overhead_pass", False) and \
+        result.get("trace_reconcile_pass", False)
+    return 0 if ok else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
@@ -404,6 +694,9 @@ def main():
 
     if "--maglev" in sys.argv[1:]:
         return run_maglev()
+
+    if "--trace" in sys.argv[1:]:
+        return run_trace()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

@@ -44,6 +44,11 @@ REQUIRED_SYMBOLS = (
     # pick surface, and the flow-cache table attach
     "vtl_maglev_rec_size", "vtl_maglev_pick", "vtl_lane_maglev_install",
     "vtl_flow_maglev_install", "vtl_flow_maglev_pick",
+    # span tracing + lane stage histograms (r13): SPSC span rings per
+    # lane, the sampling knob, and the stat-ABI widening that folds
+    # lane connections into vproxy_accept_stage_us
+    "vtl_trace_rec_size", "vtl_trace_set_sample", "vtl_trace_set_ring_cap",
+    "vtl_trace_drain", "vtl_trace_counters", "vtl_lanes_stage_stat",
 )
 
 
@@ -74,6 +79,14 @@ def test_native_so_rebuilds_and_exports_current_abi():
     assert len(vtl.lane_counters()) == 5
     assert int(lib.vtl_maglev_rec_size()) == vtl.MAGLEV_REC.size, \
         "C MaglevRec layout drifted from net/vtl.py MAGLEV_REC"
+    # trace records: the C TraceRec and the python TRACE_REC must agree
+    # bit for bit (the flow-cache ABI guard, tracing edition), and the
+    # span-id table must cover every C TR_* id
+    assert int(lib.vtl_trace_rec_size()) == vtl.TRACE_REC.size, \
+        "C TraceRec layout drifted from net/vtl.py TRACE_REC"
+    assert len(vtl.TRACE_SPANS) == 6
+    assert len(vtl.trace_counters()) == 2
+    assert len(vtl.LANE_STAGES) == 3
 
 
 def test_uring_probe_contract():

@@ -248,6 +248,9 @@ def test_resp_matrix_covers_creatable_inventory():
         "http-controller", "docker-network-plugin-controller", "tap",
         "xdp", "vlan-adaptor",
         "event-log",  # list-only flight-recorder dump (utils/events)
+        "trace",      # list-only span-trace buffer (utils/trace); the
+                      # waterfall rides the bare `trace <id>` verb —
+                      # exercised in tests/test_trace.py
         # needs a booted cluster plane (VPROXY_TPU_CLUSTER_PEERS) this
         # clusterless matrix app doesn't have; the add/remove/list verbs
         # are exercised end-to-end in tests/test_cluster.py

@@ -1,0 +1,557 @@
+"""End-to-end request tracing (utils/trace + native/vtl.cpp span rings
++ the plane instrumentation): sampling determinism, span-ring overflow
+accounting, whole-lifetime lane traces, the cross-plane stitch through
+a sampled punt, install traces bracketing unstalled dispatches, and the
+operator surfaces (`trace <id>`, `list trace`, /metrics zeros,
+/events?trace=)."""
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from vproxy_tpu.net import vtl
+from vproxy_tpu.utils import trace
+
+from tests.test_tcplb import stack  # noqa: F401 — the lb fixture
+
+needs_lanes = pytest.mark.skipif(
+    not (vtl.lanes_supported() and vtl.trace_supported()),
+    reason="native provider without lane/trace symbols")
+
+
+@pytest.fixture(autouse=True)
+def _trace_off():
+    """Every test starts and ends with the knob off and an empty
+    buffer (the knob is process-global, C side included)."""
+    trace.configure(0)
+    trace.reset()
+    yield
+    trace.configure(0)
+    trace.reset()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+# ------------------------------------------------------------- sampling
+
+def test_sampling_off_is_off():
+    assert not trace.enabled()
+    assert trace.maybe_sample() == 0
+    assert not trace.sampled_key(b"anything")
+
+
+def test_counter_sampling_every_nth():
+    trace.configure(4)
+    hits = sum(1 for _ in range(400) if trace.maybe_sample())
+    assert hits == 100  # deterministic 1-in-N, not probabilistic
+
+
+def test_key_sampling_value_stable_across_processes():
+    """The VPROXY_TPU_FAILPOINT_SEED idiom: the same (seed, key)
+    decides identically in every process — spawn two interpreters and
+    compare their decision vectors."""
+    prog = (
+        "import os; os.environ['VPROXY_TPU_TRACE_SAMPLE']='4';"
+        "os.environ['VPROXY_TPU_TRACE_SEED']='s1';"
+        "from vproxy_tpu.utils import trace;"
+        "print(''.join('1' if trace.sampled_key(b'key%d' % i) else '0'"
+        "              for i in range(200)))")
+    outs = [subprocess.run([sys.executable, "-c", prog],
+                           capture_output=True, text=True, timeout=60,
+                           ).stdout.strip() for _ in range(2)]
+    assert outs[0] and outs[0] == outs[1]
+    assert "1" in outs[0] and "0" in outs[0]  # neither all nor none
+    # a different seed samples a different subset (2^-200-ish to match)
+    prog2 = prog.replace("'s1'", "'s2'")
+    out2 = subprocess.run([sys.executable, "-c", prog2],
+                          capture_output=True, text=True,
+                          timeout=60).stdout.strip()
+    assert out2 != outs[0]
+
+
+def test_trace_id_namespaces_disjoint():
+    # python allocates odd ids; the C lane plane even ones
+    assert trace.new_trace_id() % 2 == 1
+    assert trace.new_trace_id() != trace.new_trace_id()
+
+
+# -------------------------------------------------------------- buffer
+
+def test_buffer_bounded_and_drops_counted():
+    trace.configure(1)
+    before = trace.py_dropped_total()
+    for i in range(trace.MAX_TRACES + 50):
+        trace.record_span(trace.new_trace_id(), "accept", "acl", i, 1)
+    assert len(trace.trace_ids()) == trace.MAX_TRACES
+    assert trace.py_dropped_total() >= before + 50
+
+
+def test_bind_context_and_span_record():
+    trace.configure(1)
+    tid = trace.new_trace_id()
+    assert trace.current_id() == 0
+    with trace.bind(tid):
+        assert trace.current_id() == tid
+        trace.record_span(trace.current_id(), "engine", "launch",
+                          1000, 5, fused=True)
+    assert trace.current_id() == 0
+    spans = trace.get_trace(tid)
+    assert len(spans) == 1 and spans[0]["fused"] is True
+
+
+def test_waterfall_and_summaries():
+    trace.configure(1)
+    tid = trace.new_trace_id()
+    trace.record_span(tid, "accept", "acl", 1000, 500)
+    trace.record_span(tid, "accept", "connect", 1500, 2000)
+    s = trace.summaries()
+    assert any(t["trace"] == tid and t["spans"] == 2 for t in s)
+    lines = trace.waterfall(tid)
+    assert "acl" in "\n".join(lines) and "connect" in "\n".join(lines)
+    assert trace.waterfall(999999)[0].startswith("trace 999999: not")
+
+
+# ----------------------------------------------------- operator surfaces
+
+def test_metrics_preregistered_zeros():
+    """The PR-9 silent-drops rule: the trace series exist on /metrics
+    BEFORE the first sampled request."""
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    text = GlobalInspection.get().prometheus_string()
+    assert 'vproxy_trace_drop_total{ring="lane"}' in text
+    assert 'vproxy_trace_drop_total{ring="py"}' in text
+    for plane in ("lane", "accept", "engine", "install", "cluster"):
+        assert f'vproxy_trace_spans_total{{plane="{plane}"}}' in text
+
+
+def test_command_surface_trace():
+    from vproxy_tpu.control.command import CmdError, Command
+    trace.configure(1)
+    tid = trace.new_trace_id()
+    trace.record_span(tid, "accept", "acl", 1000, 500)
+    out = Command.execute(None, "list trace")
+    assert any(f"[{tid}]" in line for line in out)
+    detail = Command.execute(None, "list-detail trace")
+    assert any(t["trace"] == tid for t in detail)
+    wf = Command.execute(None, f"trace {tid}")
+    assert "acl" in "\n".join(wf)
+    with pytest.raises(CmdError):
+        Command.execute(None, "trace nope")
+
+
+def test_flight_recorder_trace_crossref():
+    from vproxy_tpu.utils.events import FlightRecorder
+    FlightRecorder.reset()
+    rec = FlightRecorder.get()
+    rec.record("conn", "plain event")
+    rec.record("conn", "traced event", trace_id=42)
+    rec.record("conn", "unsampled", trace_id=0)  # 0 = no crossref
+    evs = rec.snapshot(trace=42)
+    assert len(evs) == 1 and evs[0]["msg"] == "traced event"
+    assert "trace_id" not in rec.snapshot(trace=None)[0]
+    assert "trace_id" not in rec.snapshot()[2]
+
+
+# ------------------------------------------------------------ C planes
+
+class _Backend:
+    """Accept-and-serve-one-line backend for raw lane tests."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(128)
+        self.port = self.sock.getsockname()[1]
+        self.alive = True
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while self.alive:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            try:
+                c.sendall(b"ok\n")
+                c.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _raw_lanes(backend_port, nlanes=1):
+    h = vtl.lanes_new("127.0.0.1", 0, 64, nlanes, 65536, False, 60000,
+                      3000)
+    rec = vtl.LANE_REC.pack(b"127.0.0.1", backend_port, 0, 1)
+    gen = vtl.lane_gen(h)
+    assert vtl.lane_install(h, rec, 1, [0], gen) == 1
+    return h, vtl.lanes_port(h)
+
+
+@needs_lanes
+def test_native_trace_rec_abi():
+    assert int(vtl.LIB.vtl_trace_rec_size()) == vtl.TRACE_REC.size
+    assert vtl.TRACE_REC.size == 40
+    assert struct.calcsize("<QQQQIBBH") == 40
+
+
+class _LanePoller:
+    """Background lane_poll pump (the lane thread's role): serving and
+    span writes happen INSIDE lane_poll, so a test that blocks on
+    recv() needs someone polling. Optionally drains the span ring
+    (SPSC: this thread is then the one consumer)."""
+
+    def __init__(self, h, drain=True):
+        self.h = h
+        self.drain = drain
+        self.recs: list = []
+        self.stop = threading.Event()
+        self.t = threading.Thread(target=self._run, daemon=True)
+        self.t.start()
+
+    def _run(self):
+        while not self.stop.is_set():
+            punts = vtl.lane_poll(self.h, 0, 50)
+            if punts:
+                for p in punts:
+                    vtl.close(p[0])
+            if self.drain:
+                self.recs += vtl.trace_drain(self.h, 0)
+            if punts is None:
+                return
+
+    def close(self):
+        self.stop.set()
+        self.t.join(5)
+
+
+@needs_lanes
+def test_lane_whole_lifetime_trace_monotonic():
+    """One sampled lane-served connection yields accept -> route_pick
+    -> connect -> splice -> close with monotonic, non-overlapping
+    stages — the whole-lifetime C-plane trace."""
+    be = _Backend()
+    trace.configure(1)
+    h, port = _raw_lanes(be.port)
+    poller = _LanePoller(h)
+    try:
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        c.settimeout(5)
+        assert c.recv(16) == b"ok\n"
+        c.close()
+        assert _wait(lambda: len(poller.recs) >= 5)
+        recs = poller.recs
+        spans = {r[5]: r for r in recs}
+        names = [vtl.TRACE_SPANS[i] for i in sorted(spans)]
+        assert names == ["accept", "route_pick", "connect", "splice",
+                         "close"], names
+        tids = {r[0] for r in recs}
+        assert len(tids) == 1 and list(tids)[0] % 2 == 0  # one EVEN id
+        ordered = sorted(recs, key=lambda r: r[1])
+        for a, b in zip(ordered, ordered[1:]):
+            assert a[1] + a[2] <= b[1] + 1000, \
+                f"stage overlap: {a} vs {b}"  # 1us clock-read slack
+        splice = spans[vtl.TRACE_SPANS.index("splice")]
+        assert splice[3] >= 3  # aux = bytes moved ("ok\n")
+    finally:
+        vtl.lanes_shutdown(h, 100)
+        poller.close()
+        vtl.lanes_free(h)
+        be.close()
+
+
+@needs_lanes
+def test_span_ring_overflow_counted_never_silent():
+    """A ring smaller than the span volume must DROP and COUNT, not
+    block the lane or grow unbounded."""
+    be = _Backend()
+    trace.configure(1)
+    vtl.trace_set_ring_cap(64)
+    poller = None
+    try:
+        h, port = _raw_lanes(be.port)
+        poller = _LanePoller(h, drain=False)  # serve but NEVER drain
+        try:
+            drops0 = vtl.trace_counters()[1]
+            # ~40 conns x 5 spans >> 64 slots, never drained meanwhile
+            for _ in range(40):
+                c = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5)
+                c.settimeout(5)
+                c.recv(16)
+                c.close()
+            assert _wait(lambda: vtl.trace_counters()[1] > drops0)
+            poller.close()
+            poller = None
+            # the drain returns at most the ring's capacity
+            recs = vtl.trace_drain(h, 0, 256)
+            total = len(recs)
+            while recs:
+                recs = vtl.trace_drain(h, 0, 256)
+                total += len(recs)
+            assert total <= 64
+        finally:
+            vtl.lanes_shutdown(h, 100)
+            if poller is not None:
+                poller.close()
+            else:
+                while vtl.lane_poll(h, 0, 100) is not None:
+                    pass
+            vtl.lanes_free(h)
+    finally:
+        vtl.trace_set_ring_cap(8192)
+        be.close()
+
+
+@needs_lanes
+def test_punt_carries_trace_id():
+    """A sampled punt's LanePunt record carries the C-side trace id so
+    the python path CONTINUES the trace (the cross-plane stitch)."""
+    be = _Backend()
+    trace.configure(1)
+    h = vtl.lanes_new("127.0.0.1", 0, 64, 1, 65536, False, 60000, 3000)
+    port = vtl.lanes_port(h)  # NO entry installed: every accept punts
+    try:
+        c = socket.create_connection(("127.0.0.1", port), timeout=5)
+        punts = []
+        deadline = time.time() + 5
+        while time.time() < deadline and not punts:
+            punts = vtl.lane_poll(h, 0, 100) or []
+        assert punts, "no punt arrived"
+        fd, kind, err, cip, cport, bip, bport, tid = punts[0]
+        assert kind == vtl.LANE_PUNT_CLASSIC
+        assert tid != 0 and tid % 2 == 0  # sampled: EVEN C-plane id
+        vtl.close(fd)
+        c.close()
+        # the C-side spans for the same trace id are in the ring
+        recs = vtl.trace_drain(h, 0)
+        names = {vtl.TRACE_SPANS[r[5]] for r in recs if r[0] == tid}
+        assert {"accept", "punt"} <= names
+    finally:
+        vtl.lanes_shutdown(h, 100)
+        while vtl.lane_poll(h, 0, 100) is not None:
+            pass
+        vtl.lanes_free(h)
+        be.close()
+
+
+# -------------------------------------------------- cross-plane stitch
+
+@needs_lanes
+def test_stitched_trace_lane_to_python(stack):
+    """A sampled connection arriving at the C lanes whose entry punts
+    (non-trivial ACL -> empty lane entry) yields ONE trace spanning the
+    C plane (accept + punt) and the python planes (acl, backend_pick,
+    connect, splice, close) with consistent monotonic timestamps — the
+    acceptance stitch."""
+    from vproxy_tpu.components.secgroup import SecurityGroup
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.rules.ir import AclRule, Proto
+    from vproxy_tpu.utils.ip import Network
+    from tests.test_tcplb import IdServer, fast_hc, tcp_get_id, \
+        wait_healthy
+
+    elg = stack["make_elg"](2)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("st-g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("st-u")
+    ups.add(g)
+    sg = SecurityGroup("st-acl", default_allow=False)
+    sg.add_rule(AclRule("lo", Network.parse("127.0.0.0/8"), Proto.TCP,
+                        1, 65535, True))
+    trace.configure(1)
+    lb = TcpLB("st-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=2, security_group=sg)
+    stack["lbs"].append(lb)
+    lb.start()
+    assert lb.lanes is not None
+    assert tcp_get_id(lb.bind_port) == "A"
+
+    def stitched():
+        # complete only: the session's connect/splice/close spans land
+        # at pump DONE, after the client already saw its bytes
+        for t in trace.summaries(last=0):
+            if "lane" in t["planes"] and "accept" in t["planes"] \
+                    and any(s["span"] == "close"
+                            for s in trace.get_trace(t["trace"])):
+                return t
+        return None
+
+    assert _wait(lambda: stitched() is not None, timeout=8), \
+        "no complete cross-plane trace appeared"
+    t = stitched()
+    spans = trace.get_trace(t["trace"])
+    by_plane = {p: [s for s in spans if s["plane"] == p]
+                for p in t["planes"]}
+    lane_names = {s["span"] for s in by_plane["lane"]}
+    py_names = {s["span"] for s in by_plane["accept"]}
+    assert {"accept", "punt"} <= lane_names
+    assert {"acl", "backend_pick", "connect", "close"} <= py_names
+    # consistent monotonic timestamps across planes: the C accept span
+    # precedes every python span (same CLOCK_MONOTONIC on both sides)
+    c_start = min(s["t_ns"] for s in by_plane["lane"])
+    py_start = min(s["t_ns"] for s in by_plane["accept"])
+    assert c_start <= py_start
+    t0 = min(s["t_ns"] for s in spans)
+    t1 = max(s["t_ns"] + s["dur_ns"] for s in spans)
+    assert 0 < t1 - t0 < 60 * 10**9  # one sane end-to-end window
+
+
+# ------------------------------------------------------ install traces
+
+def test_install_trace_brackets_unstalled_dispatch():
+    """A traced standby install shows compile / upload / swap spans,
+    and dispatches submitted DURING the install keep answering (the
+    TableInstaller stall-free contract, now span-visible)."""
+    from vproxy_tpu.rules.engine import HintMatcher, flush_installs
+    from vproxy_tpu.rules.ir import Hint, HintRule
+    trace.configure(1)
+    m = HintMatcher([HintRule(host="seed.example.com")], backend="jax")
+    m.match([Hint(host="seed.example.com")])  # warm the jit OUTSIDE
+    done = threading.Event()
+
+    def install():
+        m.set_rules([HintRule(host=f"h{i}.example.com")
+                     for i in range(3000)])
+        done.set()
+
+    th = threading.Thread(target=install, daemon=True)
+    th.start()
+    # dispatch while the standby build runs — a FRESH trace context per
+    # query (the per-trace span cap must not swallow late launches)
+    qtids = []
+    while not done.is_set():
+        qt = trace.new_trace_id()
+        qtids.append(qt)
+        with trace.bind(qt):
+            out = m.match([Hint(host="seed.example.com")])
+        assert int(out[0]) == 0
+    th.join(30)
+    flush_installs(30)
+    itids = [t["trace"] for t in trace.summaries(last=0)
+             if any(s["plane"] == "install"
+                    for s in trace.get_trace(t["trace"]))]
+    assert itids, "no install trace recorded"
+    ispans = trace.get_trace(itids[-1])
+    names = {s["span"] for s in ispans if s["plane"] == "install"}
+    assert {"compile", "upload", "swap", "install"} <= names
+    # the query traces carry launch markers from DURING the install
+    # window — dispatch never waited for the swap
+    inst = next(s for s in ispans if s["span"] == "install")
+    launches = [s for qt in qtids for s in trace.get_trace(qt)
+                if s["span"] == "launch"]
+    assert launches, "no launch markers on the query traces"
+    w0, w1 = inst["t_ns"], inst["t_ns"] + inst["dur_ns"]
+    assert any(w0 <= s["t_ns"] <= w1 for s in launches), \
+        "no dispatch launched inside the install window"
+
+
+# --------------------------------------------------- stage histograms
+
+@needs_lanes
+def test_lane_stage_histograms_fold(stack):
+    """Lane-served connections land in the SAME vproxy_accept_stage_us
+    series python-path connections populate (the stat-ABI widening)."""
+    from vproxy_tpu.components.servergroup import ServerGroup
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    from tests.test_tcplb import IdServer, fast_hc, tcp_get_id, \
+        wait_healthy
+
+    def stage_count(stage):
+        snap = GlobalInspection.get().bench_snapshot()
+        v = snap.get(f"vproxy_accept_stage_us.{stage}")
+        return v.get("n", 0) if isinstance(v, dict) else 0
+
+    before = {s: stage_count(s) for s in ("backend_pick", "handover",
+                                          "total")}
+    elg = stack["make_elg"](2)
+    srv = IdServer("A")
+    stack["servers"].append(srv)
+    g = ServerGroup("sh-g", elg, fast_hc())
+    stack["groups"].append(g)
+    g.add("a", "127.0.0.1", srv.port)
+    wait_healthy(g, 1)
+    ups = Upstream("sh-u")
+    ups.add(g)
+    lb = TcpLB("sh-lb", elg, elg, "127.0.0.1", 0, ups, protocol="tcp",
+               lanes=2)
+    stack["lbs"].append(lb)
+    lb.start()
+    assert lb.lanes is not None
+    for _ in range(10):
+        assert tcp_get_id(lb.bind_port) == "A"
+    assert lb.accepted == 0  # all served in C — YET the histograms move
+    raw = vtl.lanes_stage_stat(lb.lanes.handle, 2)
+    assert raw[0] >= 10  # C-side cumulative total-stage count
+    assert _wait(lambda: all(
+        stage_count(s) >= before[s] + 10
+        for s in ("backend_pick", "handover", "total")), timeout=8)
+
+
+def test_histogram_merge_parity():
+    """The C bucket rule must equal Histogram._bucket_of so merged
+    counts land where observe() would put them."""
+    from vproxy_tpu.utils.metrics import Histogram
+    h = Histogram("t_us")
+    # C: us<=1 -> 0 else min(bit_length(us-1), 27)
+    for us in (0, 1, 2, 3, 4, 5, 1000, 1 << 26, 1 << 40):
+        c_bucket = 0 if us <= 1 else min(max(us - 1, 1).bit_length(), 27)
+        assert h._bucket_of(float(us)) == c_bucket, us
+    h.observe(100.0)
+    deltas = [0] * 28
+    deltas[h._bucket_of(100.0)] = 3
+    h.merge(deltas, 300.0, 3)
+    assert h.value() == 4
+    assert h.percentiles()["n"] == 4
+
+
+def test_step_loop_queue_shape():
+    """StepLoop queue items carry the trace context (6-tuples) and the
+    degraded host-index path records spans for sampled queries."""
+    from vproxy_tpu.rules.engine import HintMatcher
+    from vproxy_tpu.rules.ir import Hint, HintRule
+    from vproxy_tpu.cluster.submit import StepLoop
+    trace.configure(1)
+    m = HintMatcher([HintRule(host="x.example.com")], backend="host")
+    loop = StepLoop(m, membership=None, step_ms=5, batch_cap=4,
+                    timeout_ms=200)
+    loop.degraded = True  # force the host-index path, no clock needed
+    got = []
+    tid = trace.new_trace_id()
+    with trace.bind(tid):
+        loop.submit(Hint(host="x.example.com"),
+                    lambda idx, pl: got.append(idx))
+    with loop._qlock:
+        batch = list(loop._q)
+        loop._q.clear()
+    assert len(batch[0]) == 6 and batch[0][5] == tid
+    loop._serve_host(batch)
+    assert got == [0]
+    spans = trace.get_trace(tid)
+    assert any(s["span"] == "host_index" and s["plane"] == "cluster"
+               for s in spans)

@@ -865,7 +865,15 @@ def run_all(seed: int = 0, scale: float = 1.0, only: str = None,
                         if k.startswith(("vproxy_lb_shed_total",
                                          "vproxy_lb_overload_total",
                                          "vproxy_udp_drop_total",
-                                         "vproxy_cluster_"))}
+                                         "vproxy_cluster_",
+                                         "vproxy_trace_"))}
+    # storm runs under VPROXY_TPU_TRACE_SAMPLE dump their worst traces
+    # like the bench --trace stage: the slowest sampled requests of an
+    # adversarial run, attribution included, right in the artifact
+    from vproxy_tpu.utils import trace as TR
+    if TR.enabled():
+        report["slowest_traces"] = TR.slowest(8)
+        report["stage_table"] = TR.stage_table()
     return report
 
 

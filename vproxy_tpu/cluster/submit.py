@@ -44,7 +44,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..utils import events, failpoint
+from ..utils import events, failpoint, trace
 from ..utils.log import Logger
 from .membership import Membership
 
@@ -162,13 +162,19 @@ class StepLoop:
     @staticmethod
     def _PAD_ITEM():
         from ..rules.ir import Hint
-        return (Hint(), b"\x00\x00\x00\x00", None, None, False)
+        return (Hint(), b"\x00\x00\x00\x00", None, None, False, 0)
 
     def submit(self, hint, cb: Callable[[int, object], None]) -> None:
         if self._stopped:
             raise OSError("StepLoop is stopped")
+        # the trace context rides the queue item: a sampled query's
+        # trace shows barrier vs collective vs host-index time on the
+        # node that served it; without a bound context the step plane
+        # makes its own 1-in-N decision
+        tid = trace.current_id() or trace.maybe_sample()
         with self._qlock:
-            self._q.append((hint, b"\x00\x00\x00\x00", None, cb, False))
+            self._q.append((hint, b"\x00\x00\x00\x00", None, cb, False,
+                            tid))
 
     def submit_pick(self, hint, ip: bytes, port: Optional[int],
                     cb: Callable[[int, int, object], None]) -> None:
@@ -181,8 +187,9 @@ class StepLoop:
             raise ValueError("StepLoop has no maglev plane configured")
         if self._stopped:
             raise OSError("StepLoop is stopped")
+        tid = trace.current_id() or trace.maybe_sample()
         with self._qlock:
-            self._q.append((hint, ip, port, cb, True))
+            self._q.append((hint, ip, port, cb, True, tid))
 
     def _fused_live(self) -> bool:
         """True only when the NEXT step would actually dispatch fused:
@@ -280,10 +287,10 @@ class StepLoop:
         if self._pair is not None:
             snap = self._pair.snapshot()
             out = np.asarray(self._pair.dispatch_snap(
-                snap, [(h, ip, po) for h, ip, po, _, _ in items]))
+                snap, [(h, ip, po) for h, ip, po, _, _, _ in items]))
             return (out[: len(items)], self._pair.snap_payload(snap))
         snap = self.matcher.snapshot()
-        hints = [h for h, _, _, _, _ in items]
+        hints = [h for h, _, _, _, _, _ in items]
         return (np.asarray(self.matcher.dispatch_snap(snap, hints)),
                 self.matcher.snap_payload(snap))
 
@@ -369,10 +376,28 @@ class StepLoop:
                 continue
             deadline = time.monotonic() + self.timeout_ms / 1000.0
             out = None
-            if self._barrier(deadline):
+            # sampled queries in this step: step-phase spans attach to
+            # the first one (barrier/collective are step-shared phases)
+            tids = [it[5] for it in batch if it[5]]
+            t_bar = time.monotonic() if tids else 0.0
+            barrier_ok = self._barrier(deadline)
+            if tids:
+                trace.record_span(
+                    tids[0], "cluster", "barrier", int(t_bar * 1e9),
+                    int((time.monotonic() - t_bar) * 1e9),
+                    epoch=self.epoch, step=self._step, ok=barrier_ok)
+            if barrier_ok:
                 padded = list(batch) + \
                     [self._PAD_ITEM()] * (self.batch_cap - len(batch))
+                t_col = time.monotonic() if tids else 0.0
                 out = self._timed_dispatch(padded, deadline)
+                if tids and out is not None \
+                        and out is not self._EPOCH_ABORT:
+                    trace.record_span(
+                        tids[0], "cluster", "collective",
+                        int(t_col * 1e9),
+                        int((time.monotonic() - t_col) * 1e9),
+                        batch=len(batch), fused=self._pair is not None)
             if out is self._EPOCH_ABORT:
                 # a rejoin landed mid-step (new generation): not a
                 # stall — answer this batch locally and step on in the
@@ -392,6 +417,12 @@ class StepLoop:
         up). Queued queries are served immediately — nothing fails."""
         self.barrier_stalls += 1
         self.degraded = True
+        now = time.monotonic_ns()
+        for it in batch:
+            if it[5]:  # the degrade edge lands on EVERY sampled trace
+                trace.record_span(it[5], "cluster", "barrier_stall", now,
+                                  0, epoch=self.epoch, step=self._step,
+                                  timeout_ms=self.timeout_ms)
         events.record("cluster_degrade",
                       f"step barrier stalled past {self.timeout_ms}ms at "
                       f"epoch {self.epoch} step {self._step}; degraded to "
@@ -418,8 +449,9 @@ class StepLoop:
         snap = m.snapshot()
         hp = m.snap_payload(snap)
         msnap = None if self.maglev is None else self.maglev.snapshot()
-        for hint, ip, port, cb, want in batch:
+        for hint, ip, port, cb, want, tid in batch:
             v, pick = -1, -1
+            t0 = time.monotonic_ns() if tid else 0
             try:  # a broken row delivers -1, never strands its caller
                 v = int(m.index_snap(snap, hint))
                 if want:
@@ -429,6 +461,10 @@ class StepLoop:
             except Exception:
                 _log.error("step host classify failed; delivering "
                            "no-match", exc=True)
+            if tid:
+                trace.record_span(tid, "cluster", "host_index", t0,
+                                  time.monotonic_ns() - t0,
+                                  degraded=self.degraded)
             try:
                 if want:
                     cb(v, pick, (hp, self.maglev.snap_payload(msnap)))
@@ -445,7 +481,7 @@ class StepLoop:
         # plain submits keep the hint-only cb(idx, hint_payload) shape
         paired = self._pair is not None
         hp = payload[0] if paired else payload
-        for (_, _, _, cb, want), idx in zip(batch, idxs):
+        for (_, _, _, cb, want, _), idx in zip(batch, idxs):
             row = np.atleast_1d(np.asarray(idx))
             try:
                 if want:

@@ -45,9 +45,10 @@ from typing import Optional
 
 from ..net import vtl
 from ..rules.ir import Proto
-from ..utils import events, failpoint
+from ..utils import events, failpoint, trace
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
+from ..utils.metrics import accept_stage_merge
 from .servergroup import Connector
 
 _log = Logger("accept-lanes")
@@ -126,6 +127,11 @@ class AcceptLanes:
         # readers (list-detail, HTTP detail, drain polling): the C
         # object must not be freed mid-read
         self._handle_lock = threading.Lock()
+        # cumulative C stage-histogram snapshot (lane 0's poll tick
+        # merges the deltas into vproxy_accept_stage_us)
+        self._stage_last = [(0, 0.0) for _ in vtl.LANE_STAGES]
+        self._stage_bkt_last = [[0] * vtl.LANE_STAGE_BUCKETS
+                                for _ in vtl.LANE_STAGES]
 
     # ------------------------------------------------------------ lifecycle
 
@@ -135,6 +141,10 @@ class AcceptLanes:
         launch the lane + compiler threads. Raises OSError on bind
         failure — the caller falls back to the python accept path."""
         lb = self.lb
+        # the sampling knob is one process-wide C atomic: push the
+        # current python-side value so C lanes and python flip together
+        # (trace.configure() pushes on later changes)
+        vtl.trace_set_sample(trace.sample_every())
         self.handle = vtl.lanes_new(
             lb.bind_ip, lb.bind_port, 512, self.n, lb.in_buffer_size,
             self.uring, lb.timeout_ms, lb.connect_timeout_ms)
@@ -502,6 +512,23 @@ class AcceptLanes:
             except OSError as e:
                 _log.alert(f"lane {self.lb.alias}/{idx} poll: {e!r}")
                 return
+            if trace.enabled() and vtl.trace_supported():
+                # drain THIS lane's span ring into the process buffer
+                # (SPSC: this thread is the one consumer) — until dry:
+                # a lane that stayed inside C for a whole poll window
+                # under load has a multi-chunk backlog. Knob-off cost
+                # is the enabled() branch alone.
+                try:
+                    while True:
+                        recs = vtl.trace_drain(handle, idx)
+                        if recs:
+                            trace.ingest_lane_recs(recs)
+                        if len(recs) < vtl._TRACE_DRAIN_MAX:
+                            break
+                except OSError:
+                    pass
+            if idx == 0:
+                self._merge_stage_hists(handle)
             if idx == 0:
                 # retry-budget denominator: lane-SERVED accepts never
                 # pass through _on_accept, but their connect-fail punts
@@ -531,8 +558,31 @@ class AcceptLanes:
                 except Exception:
                     vtl.close(p[0])
 
+    def _merge_stage_hists(self, handle) -> None:
+        """Fold the C stage-histogram deltas into the process-wide
+        vproxy_accept_stage_us series (satellite of the tracing PR:
+        lane-served connections used to be invisible to the stage
+        histograms python-path connections populate). Lane 0's poll
+        tick only; one ctypes call per stage per tick."""
+        if not hasattr(vtl.LIB, "vtl_lanes_stage_stat"):
+            return
+        for si, stage in enumerate(vtl.LANE_STAGES):
+            try:
+                count, sum_us, bkt = vtl.lanes_stage_stat(handle, si)
+            except OSError:
+                return
+            lc, ls = self._stage_last[si]
+            if count <= lc:
+                continue
+            deltas = [b - p for b, p in
+                      zip(bkt, self._stage_bkt_last[si])]
+            accept_stage_merge(stage, deltas, float(sum_us - ls),
+                               count - lc)
+            self._stage_last[si] = (count, float(sum_us))
+            self._stage_bkt_last[si] = bkt
+
     def _dispatch(self, punt) -> None:
-        fd, kind, err, cip, cport, bip, bport = punt
+        fd, kind, err, cip, cport, bip, bport, tid = punt
         lb = self.lb
         try:
             wl = lb.worker.next()
@@ -548,9 +598,11 @@ class AcceptLanes:
                     # same ownership contract as a python connect
                     # failure: report_failure feeds the ejection streak
                     # and the bounded retry either re-dials or closes
+                    # (a sampled punt's trace id rides along: the retry
+                    # continues the C-side trace)
                     lb._backend_connect_failed(
                         wl, fd, target, b"", f"{cip}:{cport}", None, src,
-                        0, set(), err, hint=None)
+                        0, set(), err, hint=None, tid=tid)
 
                 if not wl.run_on_loop(run):
                     vtl.close(fd)
@@ -558,7 +610,7 @@ class AcceptLanes:
             # backend vanished from the tables since the entry compiled:
             # fall through — the classic path re-decides from scratch
         if not wl.run_on_loop(
-                lambda: lb._on_accept(wl, fd, cip, cport)):
+                lambda: lb._on_accept(wl, fd, cip, cport, tid=tid)):
             vtl.close(fd)
 
     def _find_backend(self, ip: str, port: int) -> Optional[Connector]:

@@ -51,7 +51,9 @@ class Socks5Server(TcpLB):
 
     # override: every accepted conn goes through the handshake
     def _serve(self, loop, cfd: int, ip: str, port: int,
-               t_acc=None) -> None:
+               t_acc=None, tid: int = 0) -> None:
+        # tid: the accept path's trace context (unused here — the RFC
+        # 1928 session has no span instrumentation yet)
         _Socks5Session(self, loop, cfd, ip, port)
 
     # ---------------------------------------------------------- selection

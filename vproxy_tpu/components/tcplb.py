@@ -26,7 +26,7 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
-from ..utils import events, failpoint
+from ..utils import events, failpoint, trace
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
 from ..utils.metrics import accept_stage_observe
@@ -65,6 +65,15 @@ POOL_IDLE_S = float(os.environ.get("VPROXY_TPU_POOL_IDLE_S", "30"))
 # RSTs are reaped by EV_ERROR, clean FINs by the peek once it ages past
 # the window, and the residual race by the handover-failure fallback)
 POOL_VALIDATE_S = float(os.environ.get("VPROXY_TPU_POOL_VALIDATE_S", "1"))
+
+
+def _tspan(tid: int, span: str, t0: float, t1: float, **fields) -> None:
+    """Accept-plane span helper: time.monotonic() floats -> ns (same
+    CLOCK_MONOTONIC the C lane spans stamp). One branch when the
+    request is unsampled."""
+    if tid:
+        trace.record_span(tid, "accept", span, int(t0 * 1e9),
+                          int((t1 - t0) * 1e9), **fields)
 
 
 class RetryBudget:
@@ -159,13 +168,13 @@ class _SpliceBack(Handler):
 
     __slots__ = ("lb", "loop", "front_fd", "target", "head", "front",
                  "_pid", "tls_ctx", "t_acc", "t_back", "connected",
-                 "src_ip", "tried", "hint", "pooled")
+                 "src_ip", "tried", "hint", "pooled", "tid", "t_hand")
 
     def __init__(self, lb, loop, front_fd: int, target: Connector,
                  head: bytes, front: str, tls_ctx: int = 0,
                  t_acc: Optional[float] = None, src_ip: bytes = b"",
                  tried: Optional[set] = None, hint=None,
-                 pooled: bool = False):
+                 pooled: bool = False, tid: int = 0):
         self.lb = lb
         self.loop = loop
         self.front_fd = front_fd
@@ -182,6 +191,8 @@ class _SpliceBack(Handler):
         self.hint = hint           # classify hint: retries re-run the
                                    # original selection, not plain WRR
         self.pooled = pooled       # adopted a warmed pool connection
+        self.tid = tid             # trace id (0 = unsampled request)
+        self.t_hand = 0.0          # handover stamp (splice span start)
 
     def on_connected(self, conn: Connection) -> None:
         self.connected = True
@@ -230,6 +241,10 @@ class _SpliceBack(Handler):
         # moves bytes without the GIL, so a session-listing racing these
         # (lock-taking) calls must already see the pump as spliced
         accept_stage_observe("handover", now - self.t_back)
+        self.t_hand = now
+        _tspan(self.tid, "connect", self.t_back, now,
+               backend=f"{self.target.ip}:{self.target.port}",
+               pooled=self.pooled)
         if self.t_acc is not None:
             accept_stage_observe("total", now - self.t_acc)
             self.lb._observe_accept(now - self.t_acc)
@@ -243,9 +258,15 @@ class _SpliceBack(Handler):
         svr.bytes_out += b2a
         svr.conn_count -= 1
         lb._sessions_delta(-1)
+        if self.tid:
+            now = time.monotonic()
+            _tspan(self.tid, "splice", self.t_hand or now, now,
+                   bytes=a2b + b2a)
+            _tspan(self.tid, "close", now, now, err=err)
         events.record(
             "conn", f"{self.front} -> {self.target.ip}:{self.target.port} "
-            "closed", lb=lb.alias, bytes_in=a2b, bytes_out=b2a, err=err)
+            "closed", lb=lb.alias, bytes_in=a2b, bytes_out=b2a, err=err,
+            trace_id=self.tid)
 
     def on_closed(self, conn: Connection, err: int) -> None:
         self.target.svr.conn_count -= 1
@@ -258,7 +279,7 @@ class _SpliceBack(Handler):
             self.lb._backend_connect_failed(
                 self.loop, self.front_fd, self.target, self.head,
                 self.front, self.t_acc, self.src_ip, self.tls_ctx,
-                self.tried, errno_, hint=self.hint)
+                self.tried, errno_, hint=self.hint, tid=self.tid)
             self.lb._sessions_delta(-1)
             return
         if self.pooled and self._pid is None:
@@ -268,7 +289,7 @@ class _SpliceBack(Handler):
             self.lb._pooled_handover_failed(
                 self.loop, self.front_fd, self.target, self.head,
                 self.front, self.t_acc, self.src_ip, self.tls_ctx,
-                self.tried, errno_, hint=self.hint)
+                self.tried, errno_, hint=self.hint, tid=self.tid)
             self.lb._sessions_delta(-1)
             return
         self.lb._sessions_delta(-1)
@@ -726,7 +747,7 @@ class TcpLB:
                                 head: bytes, front: str,
                                 t_acc: Optional[float], src_ip: bytes,
                                 tls_ctx: int, tried: set, err: int,
-                                hint=None) -> None:
+                                hint=None, tid: int = 0) -> None:
         """A pre-handover backend connect failed (sync raise or async
         finish_connect error). Owns front_fd: either a retry attempt
         takes it over or it is closed here. Session counters for the
@@ -737,10 +758,15 @@ class TcpLB:
         willing to leave the hint group than the first pick was."""
         svr = target.svr
         tried.add(svr)
+        if tid:
+            now = time.monotonic()
+            _tspan(tid, "connect_failed", now, now,
+                   backend=f"{target.ip}:{target.port}", err=err,
+                   attempt=len(tried))
         events.record(
             "conn", f"{front} -> {target.ip}:{target.port} connect failed",
             lb=self.alias, err=err, phase="connect_failed",
-            attempt=len(tried))
+            attempt=len(tried), trace_id=tid)
         target.group.report_failure(svr, err)
         nxt = self._take_retry_slot(
             tried, front,
@@ -749,13 +775,14 @@ class TcpLB:
             vtl.close(front_fd)
             return
         self._splice(loop, front_fd, nxt, head, front, t_acc,
-                     src_ip=src_ip, tls_ctx=tls_ctx, tried=tried, hint=hint)
+                     src_ip=src_ip, tls_ctx=tls_ctx, tried=tried, hint=hint,
+                     tid=tid)
 
     def _pooled_handover_failed(self, loop, front_fd: int, target: Connector,
                                 head: bytes, front: str,
                                 t_acc: Optional[float], src_ip: bytes,
                                 tls_ctx: int, tried: set, err: int,
-                                hint=None) -> None:
+                                hint=None, tid: int = 0) -> None:
         """A warmed pool connection died at handover (post-validation).
         One stale socket says little about the backend beyond this
         session — but from the session's point of view it IS a failed
@@ -788,11 +815,15 @@ class TcpLB:
             return
         self._splice(loop, front_fd, nxt, head, front, t_acc,
                      src_ip=src_ip, tls_ctx=tls_ctx, tried=tried,
-                     hint=hint, fresh=True)
+                     hint=hint, fresh=True, tid=tid)
 
     # --------------------------------------------------------- data plane
 
-    def _on_accept(self, loop, cfd: int, ip: str, port: int) -> None:
+    def _on_accept(self, loop, cfd: int, ip: str, port: int,
+                   tid: int = 0) -> None:
+        """tid: a nonzero trace id CONTINUES a trace begun in the C
+        accept plane (a sampled lane punt); 0 lets this path make its
+        own 1-in-N sampling decision (utils/trace)."""
         if self.draining:
             # listener close raced an in-flight accept: shed it; the
             # drain contract only protects established sessions
@@ -824,36 +855,46 @@ class TcpLB:
         self.accepted += 1
         self._retry_budget.on_accept()
         t_acc = time.monotonic()
+        if tid == 0:
+            tid = trace.maybe_sample()  # one branch when the knob is off
 
         # ACL gate (SecurityGroup.allow — TcpLB.java:168-171); the lookup
         # rides the ClassifyService micro-batch queue, coalescing with
         # other in-flight accepts across connections/loops
         def on_verdict(ok: bool) -> None:
-            accept_stage_observe("acl", time.monotonic() - t_acc)
+            now = time.monotonic()
+            accept_stage_observe("acl", now - t_acc)
+            _tspan(tid, "acl", t_acc, now, allow=ok)
             if not ok or not self.started:
                 if not ok:
                     events.record("conn_denied",
                                   f"{ip}:{port} denied by ACL",
-                                  lb=self.alias)
+                                  lb=self.alias, trace_id=tid)
                 vtl.close(cfd)
                 return
             if self.worker is not self.acceptor:
                 wl = self.worker.next()
                 if not wl.run_on_loop(
-                        lambda: self._serve(wl, cfd, ip, port, t_acc)):
+                        lambda: self._serve(wl, cfd, ip, port, t_acc,
+                                            tid=tid)):
                     vtl.close(cfd)  # worker loop died; don't leak the fd
             else:
-                self._serve(loop, cfd, ip, port, t_acc)
+                self._serve(loop, cfd, ip, port, t_acc, tid=tid)
 
         try:
-            self.security_group.allow_async(Proto.TCP, parse_ip(ip),
-                                            self.bind_port, on_verdict, loop)
+            # the submit rides the trace context so the classify plane
+            # (queue wait / dispatch / launch markers) attaches its
+            # spans to THIS request's trace
+            with trace.bind(tid):
+                self.security_group.allow_async(Proto.TCP, parse_ip(ip),
+                                                self.bind_port, on_verdict,
+                                                loop)
         except Exception:
             vtl.close(cfd)  # classify queue unavailable: refuse, not leak
             raise
 
     def _serve(self, loop, cfd: int, ip: str, port: int,
-               t_acc: Optional[float] = None) -> None:
+               t_acc: Optional[float] = None, tid: int = 0) -> None:
         """Owns cfd: every branch either hands it off or closes it exactly
         once — including when `loop` died while the accept's ACL verdict
         was in flight (the verdict then runs on the dispatcher thread, or
@@ -863,15 +904,18 @@ class TcpLB:
         elif self.protocol == "tcp":
             t0 = time.monotonic()
             src_ip = parse_ip(ip)
-            conn = self.backend.next(src_ip)
-            accept_stage_observe("backend_pick", time.monotonic() - t0)
+            with trace.bind(tid):  # classify spans attach to the trace
+                conn = self.backend.next(src_ip)
+            now = time.monotonic()
+            accept_stage_observe("backend_pick", now - t0)
+            _tspan(tid, "backend_pick", t0, now)
             if conn is None:
                 vtl.close(cfd)
                 return
             self._splice(loop, cfd, conn, b"", front=f"{ip}:{port}",
-                         t_acc=t_acc, src_ip=src_ip)
+                         t_acc=t_acc, src_ip=src_ip, tid=tid)
         elif self.protocol == "http-splice":
-            self._http_classify(loop, cfd, ip, port, t_acc)
+            self._http_classify(loop, cfd, ip, port, t_acc, tid=tid)
         else:
             try:
                 L7Engine(self, loop, cfd, ip, port,
@@ -1239,7 +1283,8 @@ class TcpLB:
                 max(self.timeout_ms // 4, 1000), sweep)
 
     def _http_classify(self, loop, cfd: int, ip: str, port: int,
-                       t_acc: Optional[float] = None) -> None:
+                       t_acc: Optional[float] = None,
+                       tid: int = 0) -> None:
         lb = self
         parser = HeadParser()
         try:
@@ -1278,9 +1323,12 @@ class TcpLB:
                         head_deadline[0] = None
                     conn.pause_reading()
                     hint = parser.hint()
+                    t_cls = time.monotonic()
 
                     # classify via the cross-connection micro-batch queue
                     def on_back(back) -> None:
+                        now = time.monotonic()
+                        _tspan(tid, "classify", t_cls, now)
                         if conn.closed or conn.detached:
                             return
                         if back is None:
@@ -1292,10 +1340,12 @@ class TcpLB:
                         ffd = conn.detach()
                         lb._splice(loop, ffd, back, buffered,
                                    front=f"{ip}:{port}", t_acc=t_acc,
-                                   src_ip=parse_ip(ip), hint=hint)
+                                   src_ip=parse_ip(ip), hint=hint,
+                                   tid=tid)
 
-                    lb.backend.next_async(parse_ip(ip), hint, on_back,
-                                          loop=loop)
+                    with trace.bind(tid):  # classify-plane spans attach
+                        lb.backend.next_async(parse_ip(ip), hint, on_back,
+                                              loop=loop)
 
             def on_eof(self, conn: Connection) -> None:
                 conn.close()
@@ -1306,7 +1356,7 @@ class TcpLB:
                 head: bytes, front: str = "?",
                 t_acc: Optional[float] = None, src_ip: bytes = b"",
                 tls_ctx: int = 0, tried: Optional[set] = None,
-                hint=None, fresh: bool = False) -> None:
+                hint=None, fresh: bool = False, tid: int = 0) -> None:
         """fresh=True bypasses the warm pool (the pooled-handover retry
         path: it just drained this backend's pools and must dial a real
         connect, not fish another parked socket)."""
@@ -1318,7 +1368,7 @@ class TcpLB:
             if conn is not None:
                 self._adopt_pooled(loop, front_fd, target, conn, head,
                                    front, t_acc, src_ip, tls_ctx, tried,
-                                   hint)
+                                   hint, tid=tid)
                 return
         # C fast lane: plain splice sessions (no head bytes, no TLS)
         # ride vtl_pump_connect — ONE native call replaces the whole
@@ -1327,7 +1377,8 @@ class TcpLB:
         # injection sites live in Connection.connect.
         if (not head and not tls_ctx and not failpoint.any_armed()
                 and self._fast_splice(loop, front_fd, target, front,
-                                      t_acc, src_ip, tried, hint)):
+                                      t_acc, src_ip, tried, hint,
+                                      tid=tid)):
             return
         svr.conn_count += 1
         self._sessions_delta(1)
@@ -1342,16 +1393,18 @@ class TcpLB:
             # to 0 mid-retry (drain_wait reads it as "drained")
             self._backend_connect_failed(loop, front_fd, target, head,
                                          front, t_acc, src_ip, tls_ctx,
-                                         tried, e.errno or 1, hint=hint)
+                                         tried, e.errno or 1, hint=hint,
+                                         tid=tid)
             self._sessions_delta(-1)
             return
         back.set_handler(_SpliceBack(self, loop, front_fd, target, head,
                                      front, tls_ctx=tls_ctx, t_acc=t_acc,
-                                     src_ip=src_ip, tried=tried, hint=hint))
+                                     src_ip=src_ip, tried=tried, hint=hint,
+                                     tid=tid))
 
     def _fast_splice(self, loop, front_fd: int, target: Connector,
                      front: str, t_acc: Optional[float], src_ip: bytes,
-                     tried: set, hint) -> bool:
+                     tried: set, hint, tid: int = 0) -> bool:
         """One-crossing backend connect + pump handover in the C loop
         (net/eventloop.pump_connect). The connect resolves natively; a
         refused/unreachable/timed-out backend comes back as a
@@ -1390,7 +1443,7 @@ class TcpLB:
                 svr.conn_count -= 1
                 lb._backend_connect_failed(
                     loop, front_fd, target, b"", front, t_acc, src_ip,
-                    0, tried, err, hint=hint)
+                    0, tried, err, hint=hint, tid=tid)
                 lb._sessions_delta(-1)
                 return
             if flags & 2:
@@ -1416,6 +1469,16 @@ class TcpLB:
                 accept_stage_observe(
                     "total", (t_reg - t_acc) + connect_us / 1e6)
                 lb._observe_accept((t_reg - t_acc) + connect_us / 1e6)
+            if tid:
+                # the fast lane hears everything back at DONE: spans
+                # reconstructed from the C-measured connect duration +
+                # the registration stamp — values exact, observed late
+                t_conn1 = t_reg + connect_us / 1e6
+                _tspan(tid, "connect", t_back, t_conn1,
+                       backend=f"{target.ip}:{target.port}", fast=True)
+                now = time.monotonic()
+                _tspan(tid, "splice", t_conn1, now, bytes=a2b + b2a)
+                _tspan(tid, "close", now, now, err=err)
             lb.bytes_in += a2b
             lb.bytes_out += b2a
             svr.bytes_in += a2b
@@ -1423,7 +1486,8 @@ class TcpLB:
             svr.conn_count -= 1
             lb._sessions_delta(-1)
             events.record("conn", f"{desc} closed", lb=lb.alias,
-                          bytes_in=a2b, bytes_out=b2a, err=err)
+                          bytes_in=a2b, bytes_out=b2a, err=err,
+                          trace_id=tid)
 
         pid = pc(front_fd, target.ip, target.port, self.in_buffer_size,
                  done, timeout_ms=self.connect_timeout_ms,
@@ -1441,7 +1505,7 @@ class TcpLB:
     def _adopt_pooled(self, loop, front_fd: int, target: Connector,
                       conn: Connection, head: bytes, front: str,
                       t_acc: Optional[float], src_ip: bytes, tls_ctx: int,
-                      tried: set, hint) -> None:
+                      tried: set, hint, tid: int = 0) -> None:
         """Hand a validated warm connection straight to the pump: the
         accept path skips the whole backend-connect round trip (syscalls
         + a loop iteration waiting for writability). Reads are already
@@ -1452,7 +1516,7 @@ class TcpLB:
         self._sessions_delta(1)
         sb = _SpliceBack(self, loop, front_fd, target, head, front,
                          tls_ctx=tls_ctx, t_acc=t_acc, src_ip=src_ip,
-                         tried=tried, hint=hint, pooled=True)
+                         tried=tried, hint=hint, pooled=True, tid=tid)
         sb.connected = True
         conn.set_handler(sb)
         # NOTE: a retried session landing on a pooled socket counts its

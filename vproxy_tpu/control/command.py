@@ -70,6 +70,7 @@ TYPES = {
     "event-log": "event-log", "events": "event-log",
     "fault": "fault", "failpoint": "fault",
     "cluster-node": "cluster-node", "cn": "cluster-node",
+    "trace": "trace",
 }
 
 PARAM_KEYS = {
@@ -190,6 +191,18 @@ class Command:
             # `exit`): begin graceful drain — close listeners, flip
             # /healthz to draining, let pumps finish, then main exits
             return app.request_drain()
+        toks = line.split()
+        if len(toks) == 2 and toks[0] == "trace":
+            # `trace <id>`: one sampled request's span waterfall (the
+            # cross-plane attribution view — utils/trace). Bare verb
+            # like `drain`; `list[-detail] trace` lists the buffer.
+            from ..utils import trace as TR
+            try:
+                tid = int(toks[1])
+            except ValueError:
+                raise CmdError(f"trace id must be an integer, "
+                               f"got {toks[1]!r}")
+            return TR.waterfall(tid)
         c = Command.parse(line)
         handler = _HANDLERS.get(c.type)
         if handler is None:
@@ -1274,6 +1287,22 @@ def _h_eventlog(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for event-log")
 
 
+def _h_trace(app: Application, c: Command):
+    """`list trace` — recent sampled request traces (id, span count,
+    planes touched, end-to-end us); `list-detail trace` the raw trace
+    summaries (what GET /trace serves). The waterfall of ONE trace is
+    the bare `trace <id>` line (outside the resource grammar, like
+    `drain`) — both control surfaces accept it."""
+    from ..utils import trace as TR
+    if c.action == "list":
+        return [f"[{t['trace']}] {t['total_us']}us spans={t['spans']} "
+                f"planes={','.join(t['planes'])}"
+                for t in TR.summaries()]
+    if c.action == "list-detail":
+        return TR.summaries()
+    raise CmdError(f"unsupported action {c.action} for trace")
+
+
 def _h_fault(app: Application, c: Command):
     """`add fault <site> [probability p] [count n] [match m] [seed s]`
     arms a named failpoint (utils/failpoint — the chaos-testing
@@ -1512,6 +1541,7 @@ def _h_docker(app: Application, c: Command):
 _HANDLERS = {
     "fault": _h_fault,
     "event-log": _h_eventlog,
+    "trace": _h_trace,
     "cluster-node": _h_cluster,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,

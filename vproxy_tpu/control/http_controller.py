@@ -177,6 +177,23 @@ class HttpController:
                        else node.status())
 
         srv.get("/cluster", cluster)
+
+        def trace_ep(r: RoutingContext) -> None:
+            # span-level request tracing (docs/observability.md):
+            # summaries, or one trace's spans via ?id= — the same
+            # payloads the inspection server's /trace serves
+            from ..utils import trace as TR
+            try:
+                tid = int(r.req.query.get("id", "0"))
+            except ValueError:
+                tid = 0
+            if tid:
+                r.resp.end({"trace": tid, "spans": TR.get_trace(tid)})
+            else:
+                r.resp.end({"sample_every": TR.sample_every(),
+                            "traces": TR.summaries()})
+
+        srv.get("/trace", trace_ep)
         srv.post("/api/v1/command", self._command)
         srv.all("/api/v1/module/*", self._module)
         srv.listen(self.bind_port, self.bind_ip)

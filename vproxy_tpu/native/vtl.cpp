@@ -1003,6 +1003,15 @@ static uint64_t mono_us() {
   return (uint64_t)ts.tv_sec * 1000000u + (uint64_t)(ts.tv_nsec / 1000);
 }
 
+static uint64_t mono_ns() {
+  // CLOCK_MONOTONIC ns — the SAME clock python's time.monotonic_ns()
+  // reads on linux, so C-plane and python-plane spans of one trace
+  // order consistently without any epoch translation
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
 static void pump_set_nodelay(int fd_a, int fd_b) {
   // both sockets, in C: two fewer python->C crossings per session than
   // the old explicit vtl_set_nodelay pair (non-TCP fds just ENOPROTOOPT)
@@ -2171,6 +2180,87 @@ int vtl_wait_readable(int fd, int timeout_ms) {
   return 1;
 }
 
+// --------------------------------------------------------- span tracing
+//
+// Per-request tracing for the C accept plane (utils/trace.py is the
+// process-wide collector). When sampling is on (vtl_trace_set_sample,
+// 1-in-N), each sampled lane connection gets an EVEN trace id from one
+// global atomic (python allocates odd ids — disjoint namespaces, no
+// coordination) and its lifetime stages are written as fixed binary
+// TraceRec records into the owning lane's lock-free SPSC span ring
+// (producer = the lane thread, consumer = the python drain through
+// vtl_trace_drain — one consumer per ring by contract). Ring overflow
+// bumps a counter and drops the record: counted, never silent, never
+// blocking the accept path. Knob-off cost is one relaxed atomic load
+// per accept.
+
+#pragma pack(push, 1)
+struct TraceRec {  // must match net/vtl.py TRACE_REC
+  uint64_t trace_id;
+  uint64_t t_start_ns;  // CLOCK_MONOTONIC
+  uint64_t dur_ns;
+  uint64_t aux;         // span-dependent: bytes (splice), punt kind
+  uint32_t lane;
+  uint8_t span;         // TR_* below; contract with vtl.py TRACE_SPANS
+  uint8_t flags;        // bit0 = connect_failed teardown
+  uint16_t err;
+};
+#pragma pack(pop)
+static_assert(sizeof(TraceRec) == 40, "TraceRec ABI drifted");
+
+// span-id contract with net/vtl.py TRACE_SPANS (index == id)
+#define TR_ACCEPT 0
+#define TR_PICK 1
+#define TR_CONNECT 2
+#define TR_SPLICE 3
+#define TR_CLOSE 4
+#define TR_PUNT 5
+
+static std::atomic<uint64_t> g_trace_sample(0);   // 0 = off, N = 1-in-N
+static std::atomic<uint64_t> g_trace_next(2);     // even ids (python: odd)
+static std::atomic<uint64_t> g_trace_spans(0), g_trace_drops(0);
+static std::atomic<int> g_trace_ring_cap(8192);   // pow2; read at lanes_new
+
+struct TraceRing {
+  std::vector<TraceRec> buf;
+  std::atomic<uint64_t> head{0}, tail{0};  // head consumer, tail producer
+  uint64_t mask;
+  explicit TraceRing(int cap) : buf((size_t)cap), mask((uint64_t)cap - 1) {}
+};
+
+static void tr_push(TraceRing* r, const TraceRec& rec) {
+  uint64_t t = r->tail.load(std::memory_order_relaxed);
+  uint64_t h = r->head.load(std::memory_order_acquire);
+  if (t - h > r->mask) {  // full: count the drop, never block the lane
+    g_trace_drops.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  r->buf[t & r->mask] = rec;
+  r->tail.store(t + 1, std::memory_order_release);
+  g_trace_spans.fetch_add(1, std::memory_order_relaxed);
+}
+
+int vtl_trace_rec_size(void) { return (int)sizeof(TraceRec); }
+
+void vtl_trace_set_sample(unsigned long long n) {
+  g_trace_sample.store(n, std::memory_order_relaxed);
+}
+
+// ring capacity for lanes created AFTER this call (tests shrink it to
+// exercise overflow without thousands of connections); clamped pow2
+void vtl_trace_set_ring_cap(int cap) {
+  int c = 64;
+  while (c < cap && c < (1 << 20)) c <<= 1;
+  g_trace_ring_cap.store(c, std::memory_order_relaxed);
+}
+
+// out[0] = spans written (all rings), out[1] = ring-overflow drops
+int vtl_trace_counters(uint64_t* out) {
+  out[0] = g_trace_spans.load(std::memory_order_relaxed);
+  out[1] = g_trace_drops.load(std::memory_order_relaxed);
+  return 2;
+}
+
 // ---------------------------------------------------------- accept lanes
 //
 // The PR-5 switch-poller idiom applied to TCP: N lane threads (plain
@@ -2204,6 +2294,7 @@ struct LanePunt {  // punt record; must match net/vtl.py LANE_PUNT
   uint16_t cport, bport;
   char cip[46];
   char bip[46];
+  uint64_t trace_id;  // 0 = unsampled; else python CONTINUES the trace
 };
 struct MaglevRec {  // maglev install record; must match net/vtl.py MAGLEV_REC
   char ip[46];
@@ -2213,7 +2304,7 @@ struct MaglevRec {  // maglev install record; must match net/vtl.py MAGLEV_REC
 };
 #pragma pack(pop)
 static_assert(sizeof(LaneRec) == 50, "LaneRec ABI drifted");
-static_assert(sizeof(LanePunt) == 108, "LanePunt ABI drifted");
+static_assert(sizeof(LanePunt) == 116, "LanePunt ABI drifted");
 static_assert(sizeof(MaglevRec) == 50, "MaglevRec ABI drifted");
 
 #define LANE_PUNT_CLASSIC 0
@@ -2236,6 +2327,9 @@ struct ConnMeta {  // per live lane pump (owning lane thread only)
   std::shared_ptr<LaneRoute> route;
   int bidx;
   uint64_t last_total, last_ts_us;
+  uint64_t trace_id = 0;   // 0 = unsampled
+  uint64_t t_acc_ns = 0;   // accept stamp (stage totals + spans)
+  uint64_t t_conn_ns = 0;  // connect-resolved stamp (splice span start)
 };
 
 struct Lanes;
@@ -2243,12 +2337,14 @@ struct Lanes;
 struct Lane {
   Lanes* owner = nullptr;
   Loop* loop = nullptr;
+  int idx = 0;
   int lfd = -1;
   Handler* lh = nullptr;
   bool listener_closed = false;
   std::deque<LanePunt> punt_q;
   std::unordered_map<uint64_t, ConnMeta> meta;
   uint64_t next_sweep_us = 0;
+  TraceRing* tring = nullptr;  // SPSC span ring (this thread produces)
 #ifndef VTL_NO_URING
   bool to_pending = false;  // outstanding IORING_OP_TIMEOUT
   struct { int64_t sec, nsec; } to_ts {0, 0};  // __kernel_timespec
@@ -2285,7 +2381,87 @@ struct Lanes {
   // blind to lane-served traffic before r11). Relaxed read-modify-write
   // races between lanes lose one sample, never corrupt the value.
   std::atomic<uint64_t> lat_ewma_us{0};
+  // per-stage latency accounting for EVERY lane connection (sampled or
+  // not): the vproxy_accept_stage_us ABI widening — log2 buckets with
+  // the SAME rule as utils/metrics.Histogram._bucket_of, drained by
+  // Python as deltas and merged into the stage histograms so lane
+  // connections stop being invisible to them. Stage index contract
+  // with vtl.py LANE_STAGES: 0 backend_pick, 1 handover, 2 total.
+  unsigned long long stage_count[3] = {};
+  unsigned long long stage_sum_us[3] = {};
+  unsigned long long stage_bkt[3][28] = {};
+  // trace sampling cursor (1-in-N across this Lanes object's threads)
+  std::atomic<uint64_t> trace_seq{0};
 };
+
+#define LANE_STAGE_PICK 0
+#define LANE_STAGE_HANDOVER 1
+#define LANE_STAGE_TOTAL 2
+
+static inline int lanes_bucket(unsigned long long us) {
+  // utils/metrics.Histogram._bucket_of, integer-us form: v<=1 -> 0,
+  // else min(bit_length(v-1), 27) — 28 buckets incl. the +Inf tail
+  if (us <= 1) return 0;
+  int b = 64 - __builtin_clzll(us - 1);
+  return b > 27 ? 27 : b;
+}
+
+static inline void lanes_stage_obs(Lanes* ow, int st,
+                                   unsigned long long us) {
+  __atomic_fetch_add(&ow->stage_count[st], 1ull, __ATOMIC_RELAXED);
+  __atomic_fetch_add(&ow->stage_sum_us[st], us, __ATOMIC_RELAXED);
+  __atomic_fetch_add(&ow->stage_bkt[st][lanes_bucket(us)], 1ull,
+                     __ATOMIC_RELAXED);
+}
+
+// out = [count, sum_us, bucket0..bucket27] for one stage -> 30
+int vtl_lanes_stage_stat(void* lp, int stage, uint64_t* out) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || stage < 0 || stage > 2) return -EINVAL;
+  out[0] = __atomic_load_n(&ow->stage_count[stage], __ATOMIC_RELAXED);
+  out[1] = __atomic_load_n(&ow->stage_sum_us[stage], __ATOMIC_RELAXED);
+  for (int i = 0; i < 28; ++i)
+    out[2 + i] = __atomic_load_n(&ow->stage_bkt[stage][i],
+                                 __ATOMIC_RELAXED);
+  return 30;
+}
+
+static inline void lane_trace(Lane* ln, uint64_t tid, uint8_t span,
+                              uint64_t t0, uint64_t dur, uint64_t aux,
+                              uint16_t err, uint8_t flags = 0) {
+  if (!tid || !ln->tring) return;
+  TraceRec r;
+  r.trace_id = tid;
+  r.t_start_ns = t0;
+  r.dur_ns = dur;
+  r.aux = aux;
+  r.lane = (uint32_t)ln->idx;
+  r.span = span;
+  r.flags = flags;
+  r.err = err;
+  tr_push(ln->tring, r);
+}
+
+// drain one lane's span ring into `out` (TraceRec array, max slots);
+// SPSC: at most one concurrent caller per (lanes, idx) by contract —
+// components/lanes.py drains from that lane's own python thread
+int vtl_trace_drain(void* lp, int idx, void* out, int max) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || idx < 0 || idx >= (int)ow->lanes.size() || max <= 0)
+    return -EINVAL;
+  TraceRing* r = ow->lanes[idx]->tring;
+  if (!r) return 0;
+  uint64_t h = r->head.load(std::memory_order_relaxed);
+  uint64_t t = r->tail.load(std::memory_order_acquire);
+  TraceRec* o = (TraceRec*)out;
+  int n = 0;
+  while (h != t && n < max) {
+    o[n++] = r->buf[h & r->mask];
+    ++h;
+  }
+  r->head.store(h, std::memory_order_release);
+  return n;
+}
 
 static inline void lanes_lat_obs(Lanes* ow, uint64_t us) {
   uint64_t old = ow->lat_ewma_us.load(std::memory_order_relaxed);
@@ -2318,12 +2494,14 @@ static void addr_str(const sockaddr_storage* ss, char* ip, int iplen,
 }
 
 static void lane_emit_punt(Lane* ln, int cfd, int kind, int err,
-                           const sockaddr_storage* ss, const LaneRec* b) {
+                           const sockaddr_storage* ss, const LaneRec* b,
+                           uint64_t tid = 0) {
   LanePunt p;
   memset(&p, 0, sizeof(p));
   p.fd = cfd;
   p.kind = kind;
   p.err = err;
+  p.trace_id = tid;
   sockaddr_storage local;
   if (!ss) {  // uring multishot accept reports no peer address
     socklen_t sl = sizeof(local);
@@ -2337,10 +2515,29 @@ static void lane_emit_punt(Lane* ln, int cfd, int kind, int err,
   ln->punt_q.push_back(p);
 }
 
+// a sampled accept leaving through a punt: close out the C-side spans
+// (accept + the punt marker); the trace id rides the punt record so
+// the python path CONTINUES the same trace (the cross-plane stitch)
+static inline void lane_trace_punt(Lane* ln, uint64_t tid,
+                                   uint64_t t_acc, int kind) {
+  if (!tid) return;
+  uint64_t now = mono_ns();
+  lane_trace(ln, tid, TR_ACCEPT, t_acc, now - t_acc, 0, 0);
+  lane_trace(ln, tid, TR_PUNT, now, 0, (uint64_t)kind, 0);
+}
+
 static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   Lanes* ow = ln->owner;
+  uint64_t t_acc = mono_ns();  // stage histograms need it on every path
   ow->accepted.fetch_add(1, std::memory_order_relaxed);
   g_lane_accepted.fetch_add(1, std::memory_order_relaxed);
+  // deterministic 1-in-N sampling: one relaxed load when the knob is
+  // off; a sampled accept allocates an even trace id (python: odd)
+  uint64_t samp = g_trace_sample.load(std::memory_order_relaxed);
+  uint64_t tid = 0;
+  if (samp && ln->tring &&
+      ow->trace_seq.fetch_add(1, std::memory_order_relaxed) % samp == 0)
+    tid = g_trace_next.fetch_add(2, std::memory_order_relaxed);
   std::shared_ptr<LaneRoute> rt;
   {
     std::lock_guard<std::mutex> g(ow->mu);
@@ -2367,7 +2564,8 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
           ow->max_active.load(std::memory_order_relaxed)) {
     ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
     g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
-    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+    lane_trace_punt(ln, tid, t_acc, 0);
+    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr, tid);
     return;
   }
   if (rt->gen != cur) {
@@ -2375,9 +2573,11 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
     // path; Python re-decides against current tables and re-installs
     ow->punt_stale.fetch_add(1, std::memory_order_relaxed);
     g_lane_punt_stale.fetch_add(1, std::memory_order_relaxed);
-    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+    lane_trace_punt(ln, tid, t_acc, 0);
+    lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr, tid);
     return;
   }
+  uint64_t t_pick0 = mono_ns();
   int bidx;
   if (!rt->maglev.empty()) {
     // consistent-hash pick: one FNV over the client addr (+port when
@@ -2394,7 +2594,8 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
       // no hashable address (AF_UNIX peer): the python path decides
       ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
       g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
-      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+      lane_trace_punt(ln, tid, t_acc, 0);
+      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr, tid);
       return;
     }
     bidx = maglev_lookup(rt->maglev.data(), (int)rt->maglev.size(), ipb,
@@ -2404,12 +2605,20 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
       // install time: punt, never guess
       ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
       g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
-      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr);
+      lane_trace_punt(ln, tid, t_acc, 0);
+      lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr, tid);
       return;
     }
   } else {
     bidx = rt->seq[ow->wrr.fetch_add(1, std::memory_order_relaxed) %
                    rt->seq.size()];
+  }
+  uint64_t t_pick1 = mono_ns();
+  lanes_stage_obs(ow, LANE_STAGE_PICK, (t_pick1 - t_pick0) / 1000);
+  if (tid) {
+    lane_trace(ln, tid, TR_ACCEPT, t_acc, t_pick0 - t_acc, 0, 0);
+    lane_trace(ln, tid, TR_PICK, t_pick0, t_pick1 - t_pick0,
+               (uint64_t)bidx, 0);
   }
   errno = 0;
   uint64_t pid = pump_connect_impl(ln->loop, cfd,
@@ -2418,16 +2627,33 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   if (!pid) {  // sync refusal: punt as connect failure (retry machinery)
     ow->punt_fail.fetch_add(1, std::memory_order_relaxed);
     g_lane_punt_fail.fetch_add(1, std::memory_order_relaxed);
+    if (tid)
+      lane_trace(ln, tid, TR_PUNT, mono_ns(), 0, 1,
+                 (uint16_t)(errno ? errno : ECONNREFUSED));
     lane_emit_punt(ln, cfd, LANE_PUNT_CONNECT_FAIL,
-                   errno ? errno : ECONNREFUSED, ss, &rt->backends[bidx]);
+                   errno ? errno : ECONNREFUSED, ss, &rt->backends[bidx],
+                   tid);
     return;
   }
+  ConnMeta& m = ln->meta[pid];
+  m = ConnMeta{rt, bidx, 0, mono_us()};
+  m.trace_id = tid;
+  m.t_acc_ns = t_acc;
   {
     auto pit = ln->loop->pumps.find(pid);
-    if (pit != ln->loop->pumps.end() && !pit->second->b_connecting)
-      lanes_lat_obs(ow, pit->second->connect_us);  // sync connect: ~0us
+    if (pit != ln->loop->pumps.end() && !pit->second->b_connecting) {
+      // loopback connect resolved synchronously inside pump_connect
+      Pump* p = pit->second;
+      lanes_lat_obs(ow, p->connect_us);  // sync connect: ~0us
+      uint64_t now = mono_ns();
+      lanes_stage_obs(ow, LANE_STAGE_HANDOVER, p->connect_us);
+      lanes_stage_obs(ow, LANE_STAGE_TOTAL, (now - t_acc) / 1000);
+      if (tid) {
+        lane_trace(ln, tid, TR_CONNECT, t_pick1, now - t_pick1, 0, 0);
+        m.t_conn_ns = now;
+      }
+    }
   }
-  ln->meta[pid] = ConnMeta{rt, bidx, 0, mono_us()};
   ow->active.fetch_add(1, std::memory_order_relaxed);
 }
 
@@ -2467,14 +2693,18 @@ static void lane_reap(Lane* ln) {
     if (it == l->pumps.end()) continue;
     Pump* p = it->second;
     auto mit = ln->meta.find(id);
+    uint64_t tid = mit != ln->meta.end() ? mit->second.trace_id : 0;
     if (p->connect_failed) {
       ow->punt_fail.fetch_add(1, std::memory_order_relaxed);
       g_lane_punt_fail.fetch_add(1, std::memory_order_relaxed);
       const LaneRec* b = (mit != ln->meta.end() && mit->second.route)
                              ? &mit->second.route->backends[mit->second.bidx]
                              : nullptr;
+      if (tid)  // the trace rides the punt: python continues it
+        lane_trace(ln, tid, TR_PUNT, mono_ns(), 0, 1, (uint16_t)p->err,
+                   1);
       lane_emit_punt(ln, p->fd_a, LANE_PUNT_CONNECT_FAIL, p->err, nullptr,
-                     b);
+                     b, tid);
     } else if (p->err == ECANCELED) {
       // lane-initiated kill (idle expiry / shutdown abort): a real
       // session, but NOT a served one — hit_rate must not count it
@@ -2486,6 +2716,16 @@ static void lane_reap(Lane* ln) {
       g_lane_served.fetch_add(1, std::memory_order_relaxed);
       ow->bytes.fetch_add(p->bytes_a2b + p->bytes_b2a,
                           std::memory_order_relaxed);
+    }
+    if (tid && !p->connect_failed) {
+      // whole-lifetime close-out: the splice span covers connected ->
+      // death (bytes in aux), the close span marks teardown + errno
+      uint64_t now = mono_ns();
+      ConnMeta& m = mit->second;
+      uint64_t t0 = m.t_conn_ns ? m.t_conn_ns : m.t_acc_ns;
+      lane_trace(ln, tid, TR_SPLICE, t0, now > t0 ? now - t0 : 0,
+                 p->bytes_a2b + p->bytes_b2a, 0);
+      lane_trace(ln, tid, TR_CLOSE, now, 0, 0, (uint16_t)p->err);
     }
     if (mit != ln->meta.end()) {
       ow->active.fetch_sub(1, std::memory_order_relaxed);
@@ -2568,6 +2808,23 @@ static void lane_event(Lane* ln, Handler* h, uint32_t e) {
           p->b_connecting = false;
           p->connect_us = mono_us() - p->created_us;
           lanes_lat_obs(ln->owner, p->connect_us);
+          {  // stage histograms + the sampled trace's connect span
+            auto mit = ln->meta.find(p->id);
+            if (mit != ln->meta.end()) {
+              ConnMeta& m = mit->second;
+              uint64_t now = mono_ns();
+              lanes_stage_obs(ln->owner, LANE_STAGE_HANDOVER,
+                              p->connect_us);
+              lanes_stage_obs(ln->owner, LANE_STAGE_TOTAL,
+                              (now - m.t_acc_ns) / 1000);
+              if (m.trace_id) {
+                uint64_t dur = p->connect_us * 1000ull;
+                lane_trace(ln, m.trace_id, TR_CONNECT,
+                           now > dur ? now - dur : now, dur, 0, 0);
+                m.t_conn_ns = now;
+              }
+            }
+          }
           Handler* ha =
               l->handlers.count(p->fd_a) ? l->handlers[p->fd_a] : nullptr;
           if (ha) ep_set(l, ha, VTL_EV_READ);
@@ -2749,8 +3006,11 @@ void* vtl_lanes_new(const char* ip, int port, int backlog, int nlanes,
     }
     Lane* ln = new Lane();
     ln->owner = ow;
+    ln->idx = i;
     ln->lfd = lfd;
     ln->loop = lane_loop_new(uring);
+    ln->tring = new TraceRing(
+        g_trace_ring_cap.load(std::memory_order_relaxed));
     if (i == 0 && uring && !ln->loop->ur) uring = false;  // setup refused
     Handler* h = new Handler{Handler::LANE, (uint64_t)i, nullptr, lfd,
                              (uint32_t)-1};
@@ -2974,6 +3234,7 @@ int vtl_lanes_free(void* lp) {
   for (Lane* ln : ow->lanes) {
     if (ln->lfd >= 0) close(ln->lfd);
     vtl_free(ln->loop);
+    delete ln->tring;
     delete ln;
   }
   delete ow;

@@ -150,6 +150,15 @@ def _load() -> ctypes.CDLL:
         lib.vtl_flow_maglev_pick.argtypes = [p, ctypes.c_char_p, c, c, c]
     except AttributeError:
         pass
+    try:  # span tracing + lane stage histograms (absent pre-r13)
+        lib.vtl_trace_rec_size.argtypes = []
+        lib.vtl_trace_set_sample.argtypes = [u64]
+        lib.vtl_trace_set_ring_cap.argtypes = [c]
+        lib.vtl_trace_drain.argtypes = [p, c, ctypes.c_void_p, c]
+        lib.vtl_trace_counters.argtypes = [ctypes.POINTER(u64)]
+        lib.vtl_lanes_stage_stat.argtypes = [p, c, ctypes.POINTER(u64)]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -670,8 +679,9 @@ def switch_poll(handle: int, fd: int):
 LANE_REC = struct.Struct("<46sHBB")
 # same layout, separate ABI guard — must match the C MaglevRec
 MAGLEV_REC = struct.Struct("<46sHBB")
-# fd i32, kind i32, err i32, cport u16, bport u16, cip 46s, bip 46s
-LANE_PUNT = struct.Struct("<iiiHH46s46s")
+# fd i32, kind i32, err i32, cport u16, bport u16, cip 46s, bip 46s,
+# trace_id u64 (0 = unsampled; else python continues the C-side trace)
+LANE_PUNT = struct.Struct("<iiiHH46s46sQ")
 LANE_PUNT_CLASSIC = 0
 LANE_PUNT_CONNECT_FAIL = 1
 ESHUTDOWN = -errno.ESHUTDOWN
@@ -879,8 +889,9 @@ _lane_tls = None  # per-thread punt buffers (each lane thread has its own)
 
 def lane_poll(handle: int, idx: int, timeout_ms: int):
     """Park the lane thread in C for up to timeout_ms. -> list of punt
-    tuples (fd, kind, err, cip, cport, bip, bport), [] on timeout, or
-    None once the lane drained after lanes_shutdown (thread exits)."""
+    tuples (fd, kind, err, cip, cport, bip, bport, trace_id), [] on
+    timeout, or None once the lane drained after lanes_shutdown
+    (thread exits)."""
     global _lane_tls
     if _lane_tls is None:
         import threading
@@ -896,12 +907,115 @@ def lane_poll(handle: int, idx: int, timeout_ms: int):
         check(n)
     out = []
     for i in range(n):
-        fd, kind, err, cport, bport, cip, bip = LANE_PUNT.unpack_from(
-            buf, i * LANE_PUNT.size)
+        fd, kind, err, cport, bport, cip, bip, tid = \
+            LANE_PUNT.unpack_from(buf, i * LANE_PUNT.size)
         out.append((fd, kind, err,
                     cip.split(b"\0", 1)[0].decode(), cport,
-                    bip.split(b"\0", 1)[0].decode(), bport))
+                    bip.split(b"\0", 1)[0].decode(), bport, tid))
     return out
+
+
+# --------------------------------------------------------- span tracing
+#
+# The C accept plane's per-request tracing surface (native/vtl.cpp
+# "span tracing", utils/trace.py is the process-wide collector): each
+# lane thread writes fixed TraceRec records into its SPSC span ring;
+# components/lanes.py drains them here. Overflow is counted in C
+# (trace_counters) — never silent. The sampling knob lives in ONE C
+# atomic (trace_set_sample) so python and C flip together.
+
+# trace_id u64, t_start_ns u64, dur_ns u64, aux u64, lane u32,
+# span u8, flags u8, err u16 — must match the C TraceRec
+TRACE_REC = struct.Struct("<QQQQIBBH")
+# span-id contract with the C TR_* defines (index == id)
+TRACE_SPANS = ("accept", "route_pick", "connect", "splice", "close",
+               "punt")
+# stage-index contract with the C LANE_STAGE_* defines: the
+# vproxy_accept_stage_us stage each C-side histogram folds into
+LANE_STAGES = ("backend_pick", "handover", "total")
+LANE_STAGE_BUCKETS = 28  # log2 buckets incl. +Inf; Histogram parity
+
+_trace_supported: bool = None  # type: ignore[assignment]
+
+
+def trace_supported() -> bool:
+    """Native provider with the trace symbols AND a matching record
+    ABI (a stale committed .so fails the size check and the C plane
+    silently contributes no spans — python-plane tracing still works)."""
+    global _trace_supported
+    if _trace_supported is None:
+        ok = PROVIDER == "native" and hasattr(LIB, "vtl_trace_drain")
+        if ok:
+            try:
+                ok = int(LIB.vtl_trace_rec_size()) == TRACE_REC.size
+            except Exception:
+                ok = False
+        _trace_supported = ok
+    return _trace_supported
+
+
+def trace_set_sample(n: int) -> None:
+    """Set the C-side 1-in-N sampling knob (0 = off). No-op on a .so
+    without the trace surface."""
+    fn = getattr(LIB, "vtl_trace_set_sample", None)
+    if fn is not None:
+        fn(max(0, int(n)))
+
+
+def trace_set_ring_cap(cap: int) -> None:
+    """Ring capacity for lanes created AFTER the call (tests shrink it
+    to exercise overflow); clamped to a power of two."""
+    fn = getattr(LIB, "vtl_trace_set_ring_cap", None)
+    if fn is not None:
+        fn(int(cap))
+
+
+def trace_counters() -> tuple:
+    """(spans_written, ring_overflow_drops) — process-global C atomics;
+    zeros without the trace surface."""
+    fn = getattr(LIB, "vtl_trace_counters", None)
+    if fn is None or PROVIDER != "native":
+        return (0, 0)
+    out = (ctypes.c_uint64 * 2)()
+    fn(out)
+    return (int(out[0]), int(out[1]))
+
+
+_TRACE_DRAIN_MAX = 256
+_trace_tls = None  # per-thread drain buffers (each lane thread's own)
+
+
+def trace_drain(handle: int, idx: int, maxrecs: int = _TRACE_DRAIN_MAX):
+    """Drain one lane's span ring -> [(trace_id, t_start_ns, dur_ns,
+    aux, lane, span, flags, err), ...]. SPSC contract: one concurrent
+    caller per (handle, idx) — the lane's own python thread."""
+    global _trace_tls
+    if _trace_tls is None:
+        import threading
+        _trace_tls = threading.local()
+    buf = getattr(_trace_tls, "buf", None)
+    if buf is None:
+        buf = _trace_tls.buf = ctypes.create_string_buffer(
+            TRACE_REC.size * _TRACE_DRAIN_MAX)
+    n = LIB.vtl_trace_drain(handle, idx, buf, min(maxrecs,
+                                                  _TRACE_DRAIN_MAX))
+    if n < 0:
+        check(n)
+    return [TRACE_REC.unpack_from(buf, i * TRACE_REC.size)
+            for i in range(n)]
+
+
+def lanes_stage_stat(handle: int, stage: int) -> tuple:
+    """(count, sum_us, [28 log2 bucket counts]) for one LANE_STAGES
+    entry of one Lanes object — cumulative; python merges the DELTAS
+    into the vproxy_accept_stage_us histograms."""
+    fn = getattr(LIB, "vtl_lanes_stage_stat", None)
+    if fn is None:
+        return (0, 0, [0] * LANE_STAGE_BUCKETS)
+    out = (ctypes.c_uint64 * (2 + LANE_STAGE_BUCKETS))()
+    check(fn(handle, stage, out))
+    return (int(out[0]), int(out[1]),
+            [int(out[2 + i]) for i in range(LANE_STAGE_BUCKETS)])
 
 
 def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:

@@ -175,6 +175,16 @@ def _sync_standby(dev) -> None:
         pass
 
 
+def _install_phase(tid: int, span: str, t0_ns: int, **fields) -> None:
+    """One standby-install phase span (compile / upload / swap) on the
+    installer's trace (utils/trace) — tid 0 (constructor compiles, or
+    tracing off) records nothing."""
+    if tid:
+        from ..utils import trace
+        trace.record_span(tid, "install", span, t0_ns,
+                          time.monotonic_ns() - t0_ns, **fields)
+
+
 # batch padding at the ARRAY level: a pad row must read as "no probes,
 # no match" to the kernel. The cuckoo query arrays mark invalid probes
 # with -1 (slot/len); everything else (fp fingerprints, byte windows,
@@ -231,15 +241,26 @@ def generation_total() -> int:
     return _GENERATION[0]
 
 
-def note_launch(n: int = 1) -> None:
+def note_launch(n: int = 1, kind: str = "", fused: bool = False) -> None:
     """Count one device launch on the dispatch path (a lock-free int
     store race can only lose a count, never corrupt — same contract as
     the C-side counters). This is what makes the fused path's
     one-launch-per-batch claim SCRAPE-verifiable
     (vproxy_engine_dispatch_launches_total) instead of bench-asserted:
     every jitted submit site increments it, so fused batches move the
-    counter by exactly 1 and the unfused chain by one per chained op."""
+    counter by exactly 1 and the unfused chain by one per chained op.
+
+    Tracing (utils/trace): when the calling thread carries a sampled
+    request's trace context, every launch site also drops a `launch`
+    marker span — fused vs unfused distinguishable per launch, so a
+    trace shows exactly how many programs a batch really cost. One
+    branch when no context is bound."""
     _LAUNCHES[0] += n
+    from ..utils import trace
+    tid = trace.current_id()
+    if tid:
+        trace.record_span(tid, "engine", "launch", time.monotonic_ns(),
+                          0, kind=kind, fused=fused)
 
 
 def dispatch_launches_total() -> int:
@@ -401,7 +422,20 @@ class TableInstaller:
                     if serving_recent() else 0.0)
                 t0 = time.monotonic()
                 time.sleep(0)  # explicit preemption point pre-compile
-                matcher._install(args)
+                # installs are rare: when tracing is on, EVERY install
+                # gets its own trace — _recompile's phase spans
+                # (compile / upload / swap) attach through the bound
+                # context, so an install-under-load trace shows the
+                # standby build bracketing unstalled dispatches
+                from ..utils import trace
+                itid = trace.new_trace_id() if trace.enabled() else 0
+                with trace.bind(itid):
+                    matcher._install(args)
+                if itid:
+                    trace.record_span(
+                        itid, "install", "install", int(t0 * 1e9),
+                        int((time.monotonic() - t0) * 1e9),
+                        matcher=getattr(matcher, "_kind", "?"))
                 _swap_hist().observe((time.monotonic() - t0) * 1e3)
             except MemoryError as e:
                 # OOM keeps the log-then-die contract (utils/oom), but
@@ -537,7 +571,7 @@ def fused_dispatch(hm, hsnap: tuple, mm, msnap: tuple, hints,
     q = _fused_hint_q(hsnap[0], hints, pad_to)
     slots = _fused_slots(mtab, ips, ports, q["hostb"].shape[0])
     fn = _fused_fn()
-    note_launch()
+    note_launch(kind="cpick", fused=True)
     _FUSED_DISP[0] += 1
     return fn(fd, q, mdev, slots)
 
@@ -574,7 +608,7 @@ def fused_dispatch_all(hm, hsnap: tuple, cm, csnap: tuple, mm,
                                             a16.dtype)])
         fam = np.concatenate([fam, np.full(k, -1, fam.dtype)])
     from ..ops import fused as F
-    note_launch()
+    note_launch(kind="all", fused=True)
     _FUSED_DISP[0] += 1
     return F.fused_jit(fd, q, mdev, slots, cfd, a16, fam, None)
 
@@ -674,6 +708,9 @@ class HintMatcher:
         return int(sum(getattr(v, "nbytes", 0) for v in dev.values()))
 
     def _recompile(self) -> None:
+        from ..utils import trace
+        itid = trace.current_id()  # nonzero only under a traced install
+        t_ph = time.monotonic_ns() if itid else 0
         if self.backend == "jax":
             self._tab = H.compile_hint_hash(self._rules, caps=self._caps)
             self._caps = self._tab.caps
@@ -738,14 +775,21 @@ class HintMatcher:
         if self.backend == "jax" and fused_enabled():
             from ..ops import fused as F
             fused_dev = _to_device(F.pack_hint_table(self._tab.arrays))
+        _install_phase(itid, "compile", t_ph, matcher="hint",
+                       rules=len(self._rules))
+        t_ph = time.monotonic_ns() if itid else 0
         _sync_standby(self._dev)
         _sync_standby(fused_dev)
+        _install_phase(itid, "upload", t_ph, matcher="hint")
         time.sleep(0)  # preemption point between compile and publish
+        t_ph = time.monotonic_ns() if itid else 0
         self._pub = (self._tab, self._dev, list(self._rules), self._payload,
                      idx, fused_dev)
         self.generation += 1
         with _gen_lock:
             _GENERATION[0] += 1
+        _install_phase(itid, "swap", t_ph, matcher="hint",
+                       generation=self.generation)
 
     def encode(self, hints: Sequence[Hint]) -> dict:
         """Pre-encode a query batch for submit() (hash backend only).
@@ -755,7 +799,7 @@ class HintMatcher:
 
     def submit(self, q: dict):
         """Dispatch an encoded batch; returns the device array (async)."""
-        note_launch()
+        note_launch(kind="hint")
         idx, _ = H.hint_hash_jit(self._dev, q)
         return idx
 
@@ -850,7 +894,7 @@ class HintMatcher:
         tab, dev, rules = snap[0], snap[1], snap[2]
         if not rules or not hints:
             return np.full(len(hints), -1, np.int32)
-        note_launch()  # every branch below is one device dispatch
+        note_launch(kind="hint")  # every branch below is one dispatch
         if self.backend == "jax":
             # ONE copy of the encode+pad idiom, shared with the fused
             # entry: small batches encode straight into the padded
@@ -968,6 +1012,9 @@ class CidrMatcher:
         return int(sum(getattr(v, "nbytes", 0) for v in dev.values()))
 
     def _recompile(self) -> None:
+        from ..utils import trace
+        itid = trace.current_id()  # nonzero only under a traced install
+        t_ph = time.monotonic_ns() if itid else 0
         hash_arrays = None  # "jax" backend: source for the packed build
         if self.backend == "jax":
             tab = H.compile_cidr_hash(self._nets, acl=self._acl, caps=self._caps)
@@ -1020,15 +1067,22 @@ class CidrMatcher:
         if hash_arrays is not None and fused_enabled():
             from ..ops import fused as F
             fused_dev = _to_device(F.pack_cidr_table(hash_arrays))
+        _install_phase(itid, "compile", t_ph, matcher="cidr",
+                       rules=len(self._nets))
+        t_ph = time.monotonic_ns() if itid else 0
         _sync_standby(self._dev)
         _sync_standby(fused_dev)
+        _install_phase(itid, "upload", t_ph, matcher="cidr")
         time.sleep(0)  # preemption point between compile and publish
+        t_ph = time.monotonic_ns() if itid else 0
         self._pub = (self._dev, list(self._nets),
                      None if self._acl is None else list(self._acl),
                      self._payload, self._tab, idx, fused_dev)
         self.generation += 1
         with _gen_lock:
             _GENERATION[0] += 1
+        _install_phase(itid, "swap", t_ph, matcher="cidr",
+                       generation=self.generation)
 
     def fused_stat(self) -> dict:
         """See engine._fused_stat — packed cidr-table state."""
@@ -1120,7 +1174,7 @@ class CidrMatcher:
         dev, nets, acl = snap[0], snap[1], snap[2]
         if not nets or not addrs:
             return np.full(len(addrs), -1, np.int32)
-        note_launch()  # every branch below is one device dispatch
+        note_launch(kind="cidr")  # every branch below is one dispatch
         a16, fam = T.encode_ips(addrs)
         # route tables (acl=None) have zeroed port-range columns: the port
         # gate must be skipped entirely or every port>0 query misses

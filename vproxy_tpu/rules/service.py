@@ -94,6 +94,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from ..utils import trace
 from ..utils.log import Logger
 from .engine import SMALL_TABLE, pad_batch
 from .ir import Hint
@@ -129,13 +130,17 @@ def _apply_gil_slice() -> None:
 
 
 class _Req:
-    __slots__ = ("payload", "cb", "loop", "t0")
+    __slots__ = ("payload", "cb", "loop", "t0", "tid")
 
     def __init__(self, payload, cb, loop):
         self.payload = payload
         self.cb = cb
         self.loop = loop
         self.t0 = time.monotonic()
+        # the submitter's trace context rides the request so the
+        # dispatcher thread can attach its spans (queue wait, dispatch,
+        # d2h sync) to the sampled request that triggered them
+        self.tid = trace.current_id()
 
 
 class _Inflight:
@@ -350,6 +355,7 @@ class ClassifyService:
         the callback immediately when the submitter IS the loop thread
         (the accept path — fully synchronous), else queues it there."""
         t0 = time.monotonic()
+        tid = trace.current_id()
         snap = matcher.snapshot()
         # a host-backend matcher has no device to probe (and its
         # dispatch_snap is the O(rules) oracle — exactly the GIL-holding
@@ -369,6 +375,9 @@ class ClassifyService:
                        exc=True)
             i = (-1, -1) if kind == "cpick" else -1
         dt = time.monotonic() - t0
+        if tid:
+            trace.record_span(tid, "engine", "classify_inline",
+                              int(t0 * 1e9), int(dt * 1e9), kind=kind)
         st = self.stats
         with st.lock:
             st.oracle_queries += 1
@@ -575,10 +584,32 @@ class ClassifyService:
             self.stats.max_batch = max(self.stats.max_batch, n)
         snap = matcher.snapshot()  # ONE generation for device/oracle/payload
         lone_big = n == 1 and matcher.size() > SMALL_TABLE
+        # sampled requests in the batch: batch-shared phases (dispatch,
+        # d2h sync, host_index) attach to the FIRST one — one span, not
+        # one per request; per-request queue wait is recorded for every
+        # sampled request on BOTH serving branches
+        tids = [r.tid for r in reqs if r.tid]
+        if tids:
+            t_q = time.monotonic()
+            for r in reqs:
+                if r.tid:
+                    trace.record_span(
+                        r.tid, "engine", "queue_wait",
+                        int(r.t0 * 1e9), int((t_q - r.t0) * 1e9),
+                        kind=kind)
         if self._use_device(matcher, n):
             try:
                 t0 = time.monotonic()
-                arr = self._device_submit(kind, matcher, snap, reqs)
+                with trace.bind(tids[0] if tids else 0):
+                    # the bind makes engine-level launch markers
+                    # (rules/engine.note_launch: fused vs unfused)
+                    # attach to the sampled request's trace
+                    arr = self._device_submit(kind, matcher, snap, reqs)
+                if tids:
+                    trace.record_span(
+                        tids[0], "engine", "dispatch", int(t0 * 1e9),
+                        int((time.monotonic() - t0) * 1e9), kind=kind,
+                        batch=n)
                 return _Inflight(kind, matcher, reqs, snap, arr, t0,
                                  lone_big)
             except MemoryError:
@@ -587,6 +618,11 @@ class ClassifyService:
                 self._device_failed(e, n)
         t0 = time.monotonic()
         idxs = self._oracle_batch(kind, matcher, snap, reqs)
+        if tids:
+            trace.record_span(tids[0], "engine", "host_index",
+                              int(t0 * 1e9),
+                              int((time.monotonic() - t0) * 1e9),
+                              kind=kind, batch=n)
         if lone_big:
             self._note_lone_latency("oracle", time.monotonic() - t0)
         self.stats.bump("oracle_queries", n)
@@ -619,8 +655,15 @@ class ClassifyService:
         oracle and marks the device down, same as a submit failure."""
         n = len(inf.reqs)
         idxs = None
+        tids = [r.tid for r in inf.reqs if r.tid]
         try:
+            t_sync = time.monotonic()
             idxs = np.asarray(inf.arr)[:n]
+            if tids:
+                trace.record_span(
+                    tids[0], "engine", "d2h_sync", int(t_sync * 1e9),
+                    int((time.monotonic() - t_sync) * 1e9),
+                    kind=inf.kind, batch=n)
             if inf.lone_big:
                 self._note_lone_latency("device", time.monotonic() - inf.t0)
             with self.stats.lock:

@@ -52,9 +52,16 @@ class FlightRecorder:
         with cls._ilock:
             cls._instance = None
 
-    def record(self, kind: str, msg: str, **fields) -> None:
+    def record(self, kind: str, msg: str, trace_id: int = 0,
+               **fields) -> None:
+        """trace_id (optional, nonzero) cross-references the event with
+        a span trace (utils/trace.py): `GET /events?trace=<id>` and the
+        trace waterfall join recorder events and spans instead of two
+        unjoinable logs."""
         ev = {"seq": 0, "ts": time.time(), "mono": time.monotonic(),
               "kind": kind, "msg": msg}
+        if trace_id:
+            ev["trace_id"] = trace_id
         if fields:
             ev.update(fields)
         with self._lock:
@@ -63,10 +70,13 @@ class FlightRecorder:
                 self.dropped += 1
             self._ring.append(ev)
 
-    def snapshot(self, last: int = 0) -> list:
-        """Events oldest-first; `last` > 0 trims to the newest N."""
+    def snapshot(self, last: int = 0, trace: Optional[int] = None) -> list:
+        """Events oldest-first; `last` > 0 trims to the newest N;
+        `trace` filters to events carrying that trace_id."""
         with self._lock:
             evs = list(self._ring)
+        if trace is not None:
+            evs = [e for e in evs if e.get("trace_id") == trace]
         return evs[-last:] if last > 0 else evs
 
     def lines(self, last: int = 0) -> list:

@@ -135,6 +135,23 @@ class Histogram(Metric):
                 self._res[self._res_n % self._res_cap] = v
                 self._res_n += 1
 
+    def merge(self, bucket_deltas, sum_delta: float,
+              count_delta: int) -> None:
+        """Fold pre-bucketed counts in (the C accept-lane stage
+        histograms: native/vtl.cpp buckets with the same log2 rule and
+        python merges the per-tick deltas, so lane-served connections
+        land in the SAME series python-path connections populate). The
+        reservoir stays sample-level-only by design — percentiles fall
+        back to the bucket estimate when merged counts dominate."""
+        if count_delta <= 0:
+            return
+        with self._lock:
+            for i, d in enumerate(bucket_deltas):
+                if d:
+                    self._counts[i] += d
+            self._sum += sum_delta
+            self._count += count_delta
+
     def value(self) -> float:
         return self._count
 
@@ -342,6 +359,21 @@ class GlobalInspection:
                               lambda: self._loop_health("slip"))
         self.registry.gauge_f("vproxy_loop_callback_us_max",
                               lambda: self._loop_health("cb"))
+        # span tracing (utils/trace.py + native/vtl.cpp span rings):
+        # pre-registered so a scrape shows the ZEROS before the first
+        # sampled request — the PR-9 "silent drops counted" rule: a
+        # span ring overflowing under storm load must show on /metrics
+        # as a nonzero drop count, not as mysteriously missing spans
+        self.registry.gauge_f("vproxy_trace_spans_total",
+                              self._trace_c_spans, plane="lane")
+        for pl in ("accept", "engine", "install", "cluster"):
+            self.registry.gauge_f("vproxy_trace_spans_total",
+                                  lambda pl=pl: self._trace_py_spans(pl),
+                                  plane=pl)
+        self.registry.gauge_f("vproxy_trace_drop_total",
+                              self._trace_c_drops, ring="lane")
+        self.registry.gauge_f("vproxy_trace_drop_total",
+                              self._trace_py_drops, ring="py")
         # silent-drop accounting (udp_drop_incr below): created eagerly
         # so a scrape shows the zero before the first drop
         self.get_counter("vproxy_udp_drop_total")
@@ -394,6 +426,26 @@ class GlobalInspection:
     def _lane_counter(i: int) -> float:
         from ..net import vtl
         return float(vtl.lane_counters()[i])
+
+    @staticmethod
+    def _trace_c_spans() -> float:
+        from ..net import vtl
+        return float(vtl.trace_counters()[0])
+
+    @staticmethod
+    def _trace_c_drops() -> float:
+        from ..net import vtl
+        return float(vtl.trace_counters()[1])
+
+    @staticmethod
+    def _trace_py_spans(plane: str) -> float:
+        from . import trace
+        return float(trace.plane_spans_total(plane))
+
+    @staticmethod
+    def _trace_py_drops() -> float:
+        from . import trace
+        return float(trace.py_dropped_total())
 
     def _loop_health(self, key: str) -> float:
         with self._lock:
@@ -543,6 +595,20 @@ def accept_stage_observe(stage: str, seconds: float) -> None:
     h.observe(seconds * 1e6)
 
 
+def accept_stage_merge(stage: str, bucket_deltas, sum_us: float,
+                       count: int) -> None:
+    """Fold C-side pre-bucketed stage counts (accept lanes,
+    vtl_lanes_stage_stat deltas) into the SAME
+    vproxy_accept_stage_us{stage=} series the python accept path
+    populates — lane-served connections stop being invisible to the
+    stage histograms."""
+    h = _ACCEPT_STAGE_HISTS.get(stage)
+    if h is None:
+        h = _ACCEPT_STAGE_HISTS[stage] = GlobalInspection.get().get_histogram(
+            "vproxy_accept_stage_us", stage=stage)
+    h.merge(bucket_deltas, sum_us, count)
+
+
 def launch_inspection_http(loop, ip: str, port: int):
     """Serve /metrics, /lsof, /jstack, /events, /healthz — the
     reference's `-Dglobal_inspection=host:port` server (Main.java:
@@ -567,9 +633,33 @@ def launch_inspection_http(loop, ip: str, port: int):
             last = int(ctx.req.query.get("n", "0"))
         except ValueError:
             last = 0
-        ctx.resp.end(FlightRecorder.get().snapshot(last))
+        try:  # ?trace=<id>: only events cross-referencing that trace
+            tid = int(ctx.req.query.get("trace", "0"))
+        except ValueError:
+            tid = 0
+        ctx.resp.end(FlightRecorder.get().snapshot(last, trace=tid or None))
 
     srv.get("/events", events)
+
+    def trace_ep(ctx) -> None:
+        # GET /trace -> recent trace summaries; ?id=<trace> -> that
+        # trace's spans (start-time ordered); ?n= bounds the list
+        from . import trace as TR
+        try:
+            tid = int(ctx.req.query.get("id", "0"))
+        except ValueError:
+            tid = 0
+        if tid:
+            ctx.resp.end({"trace": tid, "spans": TR.get_trace(tid)})
+            return
+        try:
+            last = int(ctx.req.query.get("n", "64"))
+        except ValueError:
+            last = 64
+        ctx.resp.end({"sample_every": TR.sample_every(),
+                      "traces": TR.summaries(last)})
+
+    srv.get("/trace", trace_ep)
     srv.get("/faults", lambda ctx: ctx.resp.end(failpoint.active()))
 
     def cluster(ctx) -> None:
