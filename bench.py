@@ -1861,6 +1861,24 @@ def _run_trace_stage(timeout):
     return {k: rep[k] for k in keys if k in rep}
 
 
+def _run_static_analysis_stage():
+    """tools/vlint over the tree, in-process (parse-only + one clean
+    metrics-registry subprocess — seconds, not minutes): the finding
+    counts by pass ride in every round artifact so the trajectory
+    shows invariant drift over time (docs/static-analysis.md). An
+    analyzer failure is recorded, never fatal to the round."""
+    sys.stderr.write("# === stage static_analysis ===\n")
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        if here not in sys.path:
+            sys.path.insert(0, here)
+        from tools import vlint
+        rep = vlint.run_all(here)
+        return {"static_analysis": vlint.snapshot(rep)}
+    except Exception as e:  # noqa: BLE001 — artifact must survive
+        return {"static_analysis": {"error": repr(e)[:300]}}
+
+
 def _note_phase(phase_file, phase, seconds, **detail):
     """Orchestrator-side phase evidence (same stream the children write):
     backoff sleeps and abandonments become visible, dated records in the
@@ -2082,6 +2100,9 @@ def orchestrate():
     result.update(_run_trace_stage(
         float(os.environ.get("BENCH_TRACE_TIMEOUT", "300"))))
     publish(result)
+    # static analysis: vlint finding counts by pass (invariant drift)
+    result.update(_run_static_analysis_stage())
+    publish(result)
     result["phases"] = _read_phases(phase_file)
     # complete: disarm the handler so a late SIGTERM can't emit a second
     # (or interleaved) headline line after this one
@@ -2110,6 +2131,9 @@ if __name__ == "__main__":
     elif "--trace" in sys.argv:  # manual: just the tracing stage
         print(json.dumps(_run_trace_stage(
             float(os.environ.get("BENCH_TRACE_TIMEOUT", "300")))))
+        sys.exit(0)
+    elif "--static-analysis" in sys.argv:  # manual: just the vlint row
+        print(json.dumps(_run_static_analysis_stage()))
         sys.exit(0)
     elif "--fused" in sys.argv:  # manual: the fused stage in-process
         from vproxy_tpu.utils.jaxenv import force_cpu

@@ -1,0 +1,242 @@
+"""Concurrency driver for the sanitized native plane.
+
+Run by tests/test_sanitize.py inside a subprocess whose environment
+loads a `make sanitize` build (VPROXY_TPU_VTL_SO=libvtl-{tsan,asan}.so
+with the matching sanitizer runtime LD_PRELOADed). It drives the four
+hottest cross-thread paths of native/vtl.cpp at full concurrency:
+
+1. accept lanes: two lane threads running whole connection lifetimes
+   in C while an installer thread churns lane entries + generation
+   bumps and a client thread blasts short connections;
+2. flow cache: three poller threads inside vtl_switch_poll (seqlock
+   probes) racing an installer thread (vtl_flow_install + gen bumps)
+   over live VXLAN-shaped datagrams;
+3. span tracing: the lane threads produce TraceRecs into the SPSC
+   rings while dedicated drain threads consume them (sample=1 so
+   every accept traces; ring shrunk so overflow paths run too);
+4. overload/stat plane: a thread flipping lanes_set_limit /
+   lanes_set_shed and reading lanes_stat / lanes_stage_stat /
+   lanes_active / counters concurrently with everything above.
+
+Prints DRIVER_OK plus the counters on success; any sanitizer report
+is the test's to find in the log files. Pure stdlib + the vtl ctypes
+layer — importing jax here would sink the sanitizer runs in noise.
+"""
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("VPROXY_TPU_FD_PROVIDER", "native")
+
+from vproxy_tpu.net import vtl  # noqa: E402
+
+DURATION_S = float(os.environ.get("SAN_DRIVER_S", "6"))
+
+
+def _backend():
+    """Plain TCP backend: accept, read a little, close."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(128)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def run():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                c, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                c.settimeout(0.5)
+                c.recv(256)
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+    th = threading.Thread(target=run, name="backend", daemon=True)
+    th.start()
+    return port, stop, th, srv
+
+
+def lane_scenario(deadline: float, errors: list):
+    vtl.trace_set_ring_cap(256)  # small ring: overflow paths run too
+    vtl.trace_set_sample(1)      # every accept traces
+    bport, bstop, bth, bsrv = _backend()
+    h = vtl.lanes_new("127.0.0.1", 0, 128, 2, 65536, False, 2000, 1000)
+    lport = vtl.lanes_port(h)
+    rec = vtl.LANE_REC.pack(b"127.0.0.1", bport, 0, 1)
+    gen = vtl.lane_gen(h)
+    assert vtl.lane_install(h, rec, 1, [0], gen) >= 0
+    stop = threading.Event()
+    threads = []
+
+    def poller(idx):
+        while True:
+            punts = vtl.lane_poll(h, idx, 50)
+            if punts is None:
+                return  # ESHUTDOWN after drain
+            for p in punts:
+                vtl.close(p[0])  # punted client fds are ours to close
+
+    def drainer(idx):
+        # SPSC consumer on its own thread while the lane thread
+        # produces from inside vtl_lane_poll
+        while not stop.is_set():
+            vtl.trace_drain(h, idx, 64)
+            time.sleep(0.002)
+
+    def installer():
+        while not stop.is_set():
+            vtl.lane_gen_bump(h)
+            g = vtl.lane_gen(h)
+            vtl.lane_install(h, rec, 1, [0], g)  # -EAGAIN on races: fine
+            time.sleep(0.001)
+
+    def overload():
+        flip = False
+        while not stop.is_set():
+            vtl.lanes_set_limit(h, 0 if flip else 1 << 20)
+            vtl.lanes_set_shed(h, flip)
+            vtl.lanes_stat(h)
+            for st in range(len(vtl.LANE_STAGES)):
+                vtl.lanes_stage_stat(h, st)
+            vtl.lanes_active(h)
+            vtl.lane_counters()
+            vtl.trace_counters()
+            flip = not flip
+            time.sleep(0.003)
+
+    def client():
+        while time.monotonic() < deadline and not stop.is_set():
+            try:
+                c = socket.create_connection(("127.0.0.1", lport),
+                                             timeout=1.0)
+                c.sendall(b"x" * 64)
+                c.close()
+            except OSError:
+                pass  # shed/RST windows are part of the scenario
+
+    for i in range(2):
+        threads.append(threading.Thread(target=poller, args=(i,),
+                                        name=f"lane{i}", daemon=True))
+        threads.append(threading.Thread(target=drainer, args=(i,),
+                                        name=f"drain{i}", daemon=True))
+    threads += [threading.Thread(target=installer, daemon=True),
+                threading.Thread(target=overload, daemon=True),
+                threading.Thread(target=client, daemon=True),
+                threading.Thread(target=client, daemon=True)]
+    for t in threads:
+        t.start()
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+    stop.set()
+    vtl.lanes_shutdown(h, 500)
+    for t in threads:
+        t.join(timeout=5)
+        if t.is_alive():
+            errors.append(f"thread {t.name} wedged")
+    stat = vtl.lanes_stat(h)
+    vtl.lanes_free(h)
+    vtl.trace_set_sample(0)
+    bstop.set()
+    bth.join(timeout=2)
+    bsrv.close()
+    return {"lane_accepted": stat[0], "lane_served": stat[1]}
+
+
+def flow_scenario(deadline: float, errors: list):
+    fc = vtl.flowcache_new(1024, 10000)
+    rx = vtl.udp_bind("127.0.0.1", 0)
+    _, rx_port = vtl.sock_name(rx)
+    tx = vtl.udp_bind("127.0.0.1", 0)
+    _, tx_port = vtl.sock_name(tx)
+    # a bare VXLAN frame (flags 0x08, reserved zeros) big enough for
+    # eth+ipv4; eth_type 0x0801 keeps the ip fields out of the key
+    vni, eth_dst, eth_type = b"\x01\x02\x03", b"\xaa" * 6, b"\x08\x01"
+    # VXLAN: flags(1) reserved(3) | vni at b[4:7] | then eth_dst b[8:14]
+    frame = (b"\x08\x00\x00\x00" + vni + b"\x00" + eth_dst
+             + b"\xbb" * 6 + eth_type + b"\x00" * 22)
+    assert len(frame) >= 42
+    key_ip = struct.unpack(">I", socket.inet_aton("127.0.0.1"))[0]
+    rec = vtl.FLOW_REC.pack(
+        key_ip, tx_port, vni, eth_dst, eth_type, b"\0" * 4, b"\0" * 4,
+        0, 3, 0, 5, b"\0" * 3, b"\0" * 6, b"\0" * 6, 0, 0, 0)  # DROP
+    stop = threading.Event()
+
+    def installer():
+        while not stop.is_set():
+            g = vtl.switch_gen(fc)
+            vtl.flow_install(fc, rec, 1, g)
+            vtl.flowcache_stat(fc)
+            time.sleep(0)  # yield: install every scheduling slot
+            if int(time.monotonic() * 1000) % 7 == 0:
+                vtl.switch_gen_bump(fc)  # gate churn -> stale probes
+
+    def poller():
+        while not stop.is_set():
+            vtl.switch_poll(fc, rx)
+            time.sleep(0)
+
+    def sender():
+        while time.monotonic() < deadline and not stop.is_set():
+            for _ in range(32):
+                try:
+                    vtl.sendto(tx, frame, "127.0.0.1", rx_port)
+                except OSError:
+                    pass
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=installer, daemon=True),
+               threading.Thread(target=sender, daemon=True)]
+    threads += [threading.Thread(target=poller, daemon=True)
+                for _ in range(3)]
+    for t in threads:
+        t.start()
+    while time.monotonic() < deadline:
+        time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+        if t.is_alive():
+            errors.append("flow scenario thread wedged")
+    hit, miss, _evict, stale, _fwd = vtl.flowcache_counters()[:5]
+    vtl.flowcache_free(fc)
+    vtl.close(rx)
+    vtl.close(tx)
+    return {"fc_hit": hit, "fc_miss": miss, "fc_stale": stale}
+
+
+def main() -> int:
+    if vtl.PROVIDER != "native":
+        print("DRIVER_SKIP: native provider unavailable")
+        return 0
+    errors: list = []
+    out = {}
+    half = DURATION_S / 2
+    out.update(lane_scenario(time.monotonic() + half, errors))
+    out.update(flow_scenario(time.monotonic() + half, errors))
+    if errors:
+        print("DRIVER_FAIL:", "; ".join(errors))
+        return 1
+    # the scenarios must have actually exercised the paths — a driver
+    # that silently serves nothing proves nothing about the races
+    if out["lane_accepted"] == 0 or (out["fc_hit"] + out["fc_miss"]) == 0:
+        print(f"DRIVER_FAIL: no traffic reached the hot paths {out}")
+        return 1
+    print(f"DRIVER_OK {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -297,3 +297,32 @@ def test_loop_registration_lifecycle():
     assert len(gi._loops) == before + 1
     lp.close()
     assert len(gi._loops) == before
+
+
+def test_families_pre_registered_before_any_traffic():
+    """The PR-9 pre-registration rule, enforced repo-wide by vlint's
+    registry audit (docs/static-analysis.md): the closed-vocabulary
+    families must exist — at zero — on a scrape before any event, and
+    the histogram config owned by the eager site must survive the
+    component-side get_histogram dedup."""
+    import vproxy_tpu.vswitch.swmetrics  # noqa: F401 — registry module
+    gi = GlobalInspection.get()
+    text = gi.registry.prometheus_text()
+    for stage in ("acl", "classify", "backend_pick", "handover",
+                  "total"):
+        assert f'vproxy_accept_stage_us_count{{stage="{stage}"}}' in text
+    for reason in ("acl_deny", "arp_unresolved", "egress_short_write",
+                   "route_miss", "same_iface", "unknown_vni"):
+        assert f'vproxy_switch_drops_total{{reason="{reason}"}}' in text
+    assert 'vproxy_switch_slowpath_total{reason="bad_csum"}' in text
+    assert 'vproxy_switch_forwards_total{path="fast"}' in text
+    assert "vproxy_switch_rx_total" in text
+    assert "vproxy_engine_swap_ms_count" in text
+    assert "vproxy_maglev_build_ms_count" in text
+    # reservoir config lives at the eager site; the creators in
+    # rules/engine.py and rules/maglev.py must resolve to the SAME
+    # instances (first-creation-wins through _get_named)
+    from vproxy_tpu.rules import maglev
+    from vproxy_tpu.rules.engine import _swap_hist
+    assert _swap_hist()._res_cap == 512
+    assert maglev._build_ms()._res_cap == 256

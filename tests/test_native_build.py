@@ -13,6 +13,7 @@ import ctypes
 import os
 import shutil
 import subprocess
+import sys
 
 import pytest
 
@@ -67,23 +68,44 @@ def test_native_so_rebuilds_and_exports_current_abi():
     missing = [s for s in REQUIRED_SYMBOLS if not hasattr(lib, s)]
     assert not missing, f"libvtl.so lacks symbols: {missing}"
     from vproxy_tpu.net import vtl
-    assert int(lib.vtl_flow_rec_size()) == vtl.FLOW_REC.size, \
-        "C FlowRec layout drifted from net/vtl.py FLOW_REC"
+
+    # Shared-record ABI: assertions GENERATED from vlint's extracted
+    # struct model (tools/vlint/structs.py parses both sides of every
+    # mirror) instead of a hand-maintained size list — the model is
+    # the single source of truth, this proves the COMPILED .so agrees
+    # with it, and the runtime vtl_*_rec_size guards in net/vtl.py
+    # stay as the load-time backstop for prebuilt libraries.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tools.vlint import structs as vstructs
+    model = vstructs.shared_model(os.path.join(NATIVE_DIR, "..", ".."))
+    size_fns = {"FLOW_REC": lib.vtl_flow_rec_size,
+                "LANE_REC": lib.vtl_lane_rec_size,
+                "LANE_PUNT": lib.vtl_lane_punt_size,
+                "MAGLEV_REC": lib.vtl_maglev_rec_size,
+                "TRACE_REC": lib.vtl_trace_rec_size}
+    assert set(size_fns) == set(model), \
+        "a shared record gained/lost its vtl_*_rec_size guard — " \
+        "update size_fns AND vlint's SHARED_RECORDS together"
+    for py_name, (py_rec, c_rec) in sorted(model.items()):
+        runtime = getattr(vtl, py_name)
+        assert runtime.size == py_rec.size, \
+            f"{py_name}: loaded struct.Struct disagrees with the " \
+            f"parsed model (vlint parser drift)"
+        assert int(size_fns[py_name]()) == c_rec.size == py_rec.size, \
+            f"{py_name}: compiled C sizeof({c_rec.name}) drifted " \
+            f"from the mirror"
+        assert len(py_rec.fields) == len(c_rec.fields), \
+            f"{py_name}: field count drifted (zip would truncate)"
+        for pf, cf in zip(py_rec.fields, c_rec.fields):
+            assert (pf.name, pf.offset, pf.size, pf.kind) == \
+                (cf.name, cf.offset, cf.size, cf.kind), \
+                f"{py_name}.{pf.name} drifted from C " \
+                f"{c_rec.name}.{cf.name}"
+
     assert len(vtl.flowcache_counters()) == 5 + len(vtl.FLOW_DROP_REASONS)
-    # lane install/punt records: the C structs and the python packing
-    # must agree bit for bit (the flow-cache ABI guard, lane edition)
-    assert int(lib.vtl_lane_rec_size()) == vtl.LANE_REC.size, \
-        "C LaneRec layout drifted from net/vtl.py LANE_REC"
-    assert int(lib.vtl_lane_punt_size()) == vtl.LANE_PUNT.size, \
-        "C LanePunt layout drifted from net/vtl.py LANE_PUNT"
     assert len(vtl.lane_counters()) == 5
-    assert int(lib.vtl_maglev_rec_size()) == vtl.MAGLEV_REC.size, \
-        "C MaglevRec layout drifted from net/vtl.py MAGLEV_REC"
-    # trace records: the C TraceRec and the python TRACE_REC must agree
-    # bit for bit (the flow-cache ABI guard, tracing edition), and the
-    # span-id table must cover every C TR_* id
-    assert int(lib.vtl_trace_rec_size()) == vtl.TRACE_REC.size, \
-        "C TraceRec layout drifted from net/vtl.py TRACE_REC"
+    # span-id / stage-id tables must cover every C TR_* / LANE_STAGE_*
     assert len(vtl.TRACE_SPANS) == 6
     assert len(vtl.trace_counters()) == 2
     assert len(vtl.LANE_STAGES) == 3

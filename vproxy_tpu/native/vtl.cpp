@@ -1399,6 +1399,68 @@ static std::atomic<uint64_t> g_fc_hit(0), g_fc_miss(0), g_fc_evict(0),
     g_fc_stale(0), g_fc_fwd(0);
 static std::atomic<uint64_t> g_fc_drop[FC_DROP_REASONS];
 
+// ------------- seqlock data plane: intentionally-racy, confined -------------
+//
+// The flow table is 1 writer (vtl_flow_install, the owning loop
+// thread) / N readers (fc_probe on the SO_REUSEPORT poller threads).
+// The seq word plus the fences carry all ordering; the entry PAYLOAD
+// is read while the writer may be mid-write BY DESIGN — a torn read
+// is discarded by the seq re-check that brackets the copy, and a
+// discarded probe is a miss (always safe: Python re-decides). C++
+// cannot express "benign under a seqlock" short of making every field
+// atomic, so the racy accesses are confined to these two helpers and
+// compiled without TSan instrumentation; everything OUTSIDE them
+// operates on the consistent copy and stays fully checked (`make
+// sanitize` + tests/test_sanitize.py, docs/static-analysis.md).
+// last_hit_us is the one field mutated from BOTH sides (probes stamp
+// hits, install reads it for LRU picks), so it is atomic everywhere.
+
+// GCC defines __SANITIZE_THREAD__; clang spells it __has_feature
+#if defined(__has_feature)
+#if __has_feature(thread_sanitizer) && !defined(__SANITIZE_THREAD__)
+#define __SANITIZE_THREAD__ 1
+#endif
+#endif
+#if defined(__SANITIZE_THREAD__)
+// noinline matters: inlined into an instrumented caller, the body
+// would be re-instrumented and the annotation silently dropped
+#define VTL_NO_TSAN __attribute__((no_sanitize("thread"), noinline))
+#else
+#define VTL_NO_TSAN
+#endif
+
+VTL_NO_TSAN static void fc_racy_copy(FlowEntry* out,
+                                     const FlowEntry& e) {
+#if defined(__SANITIZE_THREAD__)
+  // volatile word loop: libc memcpy would report through the
+  // annotation via the TSan interceptor (production keeps memcpy)
+  const volatile unsigned char* s = (const volatile unsigned char*)&e;
+  unsigned char* d = (unsigned char*)out;
+  for (size_t i = 0; i < sizeof(FlowEntry); ++i) d[i] = s[i];
+#else
+  memcpy(out, &e, sizeof(FlowEntry));
+#endif
+}
+
+VTL_NO_TSAN static void fc_racy_write(FlowEntry* dst, const FlowRec& rec,
+                                      uint64_t gen, uint64_t now,
+                                      uint64_t expire) {
+  dst->key = rec.key;
+  dst->action = rec.action;
+  dst->flags = rec.flags;
+  dst->drop_reason = rec.drop_reason < FC_DROP_REASONS
+                         ? rec.drop_reason : FC_DROP_REASONS - 1;
+  memcpy(dst->new_vni, rec.new_vni, 3);
+  memcpy(dst->new_dst, rec.new_dst, 6);
+  memcpy(dst->new_src, rec.new_src, 6);
+  dst->out_ip = rec.out_ip;
+  dst->out_port = rec.out_port;
+  dst->tap_fd = rec.tap_fd;
+  dst->gen = gen;
+  dst->expire_us = expire;
+  __atomic_store_n(&dst->last_hit_us, now, __ATOMIC_RELAXED);
+}
+
 static uint64_t fc_hash(const FlowKey& k) {
   const uint8_t* p = (const uint8_t*)&k;
   uint64_t h = 1469598103934665603ull;  // FNV-1a 64
@@ -1485,7 +1547,11 @@ int vtl_flow_install(void* p, const void* recs, int n, uint64_t gen) {
         if (!freeslot) freeslot = &e;
         continue;
       }
-      if (!lru || e.last_hit_us < lru->last_hit_us) lru = &e;
+      // atomic: probes on other threads stamp last_hit_us on hits
+      if (!lru || __atomic_load_n(&e.last_hit_us, __ATOMIC_RELAXED)
+                      < __atomic_load_n(&lru->last_hit_us,
+                                        __ATOMIC_RELAXED))
+        lru = &e;
     }
     FlowEntry* dst = match ? match : (freeslot ? freeslot : lru);
     if (!dst) continue;
@@ -1496,20 +1562,7 @@ int vtl_flow_install(void* p, const void* recs, int n, uint64_t gen) {
     uint32_t s = __atomic_load_n(&dst->seq, __ATOMIC_RELAXED);
     __atomic_store_n(&dst->seq, s + 1, __ATOMIC_RELAXED);
     __atomic_thread_fence(__ATOMIC_SEQ_CST);
-    dst->key = rec.key;
-    dst->action = rec.action;
-    dst->flags = rec.flags;
-    dst->drop_reason = rec.drop_reason < FC_DROP_REASONS
-                           ? rec.drop_reason : FC_DROP_REASONS - 1;
-    memcpy(dst->new_vni, rec.new_vni, 3);
-    memcpy(dst->new_dst, rec.new_dst, 6);
-    memcpy(dst->new_src, rec.new_src, 6);
-    dst->out_ip = rec.out_ip;
-    dst->out_port = rec.out_port;
-    dst->tap_fd = rec.tap_fd;
-    dst->gen = gen;
-    dst->expire_us = now + fc->ttl_us;
-    dst->last_hit_us = now;
+    fc_racy_write(dst, rec, gen, now, now + fc->ttl_us);
     __atomic_thread_fence(__ATOMIC_SEQ_CST);
     __atomic_store_n(&dst->seq, s + 2, __ATOMIC_RELEASE);
     ++installed;
@@ -1517,10 +1570,12 @@ int vtl_flow_install(void* p, const void* recs, int n, uint64_t gen) {
   return installed;
 }
 
-// Probe from any poller thread: copies the matched entry out under its
-// seqlock (any concurrent install movement degrades to a miss). Stale
-// and expired entries are left for the install path to reclaim —
-// readers never mutate table state beyond the last_hit_us stat.
+// Probe from any poller thread: copies the candidate entry out under
+// its seqlock FIRST, then interprets only the consistent copy (any
+// concurrent install movement degrades to a miss — safe, Python
+// re-decides). Stale and expired entries are left for the install
+// path to reclaim — readers never mutate table state beyond the
+// atomic last_hit_us stat.
 static bool fc_probe(FlowCache* fc, const FlowKey& key, uint64_t cur,
                      uint64_t now, FlowEntry* out) {
   uint64_t h = fc_hash(key);
@@ -1528,11 +1583,14 @@ static bool fc_probe(FlowCache* fc, const FlowKey& key, uint64_t cur,
     FlowEntry& e = fc->slots[(h + (uint64_t)k) & fc->mask];
     uint32_t s1 = __atomic_load_n(&e.seq, __ATOMIC_ACQUIRE);
     if (s1 & 1) continue;  // mid-install: miss, reinstall will follow
-    if (e.action == FC_ACT_EMPTY) return false;
-    if (memcmp(&e.key, &key, sizeof(FlowKey))) continue;
-    memcpy(out, &e, sizeof(FlowEntry));
+    fc_racy_copy(out, e);  // seqlock-bracketed payload copy
     __atomic_thread_fence(__ATOMIC_ACQUIRE);
-    if (__atomic_load_n(&e.seq, __ATOMIC_RELAXED) != s1) return false;
+    if (__atomic_load_n(&e.seq, __ATOMIC_RELAXED) != s1)
+      continue;  // THIS slot moved mid-copy (torn copy, untrusted):
+                 // skip it — each slot's seqlock is independent, and
+                 // the flow may live in a later, untouched slot
+    if (out->action == FC_ACT_EMPTY) return false;
+    if (memcmp(&out->key, &key, sizeof(FlowKey))) continue;
     if (out->gen != cur) {
       // the generation gate: a mutation since install forces a miss so
       // the Python policy path re-decides against current tables

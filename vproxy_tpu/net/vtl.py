@@ -38,11 +38,21 @@ def _build() -> None:
 
 
 def _load() -> ctypes.CDLL:
-    src = os.path.join(_DIR, "vtl.cpp")
-    if not os.path.exists(_SO) or (
-            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_SO)):
-        _build()
-    lib = ctypes.CDLL(_SO)
+    # VPROXY_TPU_VTL_SO points at an explicit build artifact — the
+    # sanitizer suite (make sanitize -> libvtl-{tsan,asan}.so, driven
+    # by tests/test_sanitize.py under LD_PRELOAD of the runtime) and
+    # any side-by-side A/B build. An explicit path is loaded as-is:
+    # no staleness rebuild, and failures are loud.
+    override = os.environ.get("VPROXY_TPU_VTL_SO", "")
+    if override:
+        lib = ctypes.CDLL(override)
+    else:
+        src = os.path.join(_DIR, "vtl.cpp")
+        if not os.path.exists(_SO) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_SO)):
+            _build()
+        lib = ctypes.CDLL(_SO)
     c = ctypes.c_int
     p = ctypes.c_void_p
     u64 = ctypes.c_uint64
@@ -559,6 +569,13 @@ def recvmmsg(fd: int):
 # ip_src 4s, ip_dst 4s, proto B | action B, flags B, drop_reason B,
 # new_vni 3s, new_dst 6s, new_src 6s, out_ip u32, out_port u16, tap_fd i
 FLOW_REC = struct.Struct("<IH3s6s2s4s4sBBBB3s6s6sIHi")
+# field-name contract with the C FlowRec (FlowKey flattened), checked
+# name/offset/size/type field-by-field by tools/vlint's ABI pass — the
+# total-size guard alone lets two compensating field errors through
+FLOW_REC_FIELDS = ("sender_ip", "sender_port", "vni", "eth_dst",
+                   "eth_type", "ip_src", "ip_dst", "proto", "action",
+                   "flags", "drop_reason", "new_vni", "new_dst",
+                   "new_src", "out_ip", "out_port", "tap_fd")
 # index contract with the C g_fc_drop table
 FLOW_DROP_REASONS = ("acl_deny", "same_iface", "route_miss",
                      "unknown_vni", "egress_short_write", "other")
@@ -677,11 +694,15 @@ def switch_poll(handle: int, fd: int):
 
 # ip 46s, port u16, v6 u8, weight u8 — must match the C LaneRec
 LANE_REC = struct.Struct("<46sHBB")
+LANE_REC_FIELDS = ("ip", "port", "v6", "weight")  # vlint ABI contract
 # same layout, separate ABI guard — must match the C MaglevRec
 MAGLEV_REC = struct.Struct("<46sHBB")
+MAGLEV_REC_FIELDS = ("ip", "port", "v6", "weight")
 # fd i32, kind i32, err i32, cport u16, bport u16, cip 46s, bip 46s,
 # trace_id u64 (0 = unsampled; else python continues the C-side trace)
 LANE_PUNT = struct.Struct("<iiiHH46s46sQ")
+LANE_PUNT_FIELDS = ("fd", "kind", "err", "cport", "bport", "cip",
+                    "bip", "trace_id")
 LANE_PUNT_CLASSIC = 0
 LANE_PUNT_CONNECT_FAIL = 1
 ESHUTDOWN = -errno.ESHUTDOWN
@@ -927,6 +948,8 @@ def lane_poll(handle: int, idx: int, timeout_ms: int):
 # trace_id u64, t_start_ns u64, dur_ns u64, aux u64, lane u32,
 # span u8, flags u8, err u16 — must match the C TraceRec
 TRACE_REC = struct.Struct("<QQQQIBBH")
+TRACE_REC_FIELDS = ("trace_id", "t_start_ns", "dur_ns", "aux", "lane",
+                    "span", "flags", "err")
 # span-id contract with the C TR_* defines (index == id)
 TRACE_SPANS = ("accept", "route_pick", "connect", "splice", "close",
                "punt")

@@ -287,9 +287,10 @@ def table_bytes_total(kind: str) -> int:
 
 
 def _swap_hist():
+    # pre-registered (reservoir config included) in
+    # GlobalInspection.__init__ — this resolves to that instance
     from ..utils.metrics import GlobalInspection
-    return GlobalInspection.get().get_histogram("vproxy_engine_swap_ms",
-                                                reservoir=512)
+    return GlobalInspection.get().get_histogram("vproxy_engine_swap_ms")
 
 
 class _InstallTicket:

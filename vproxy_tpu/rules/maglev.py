@@ -216,9 +216,10 @@ def _builds_total():
 
 
 def _build_ms():
+    # pre-registered (reservoir config included) in
+    # GlobalInspection.__init__ — this resolves to that instance
     from ..utils.metrics import GlobalInspection
-    return GlobalInspection.get().get_histogram("vproxy_maglev_build_ms",
-                                                reservoir=256)
+    return GlobalInspection.get().get_histogram("vproxy_maglev_build_ms")
 
 
 def _remap_gauge():

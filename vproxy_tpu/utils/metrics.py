@@ -381,6 +381,20 @@ class GlobalInspection:
         # the same reason — a scrape shows the zeros before any build
         self.get_counter("vproxy_maglev_table_builds_total")
         self.get_gauge("vproxy_maglev_remap_fraction")
+        # accept-path stage histograms (the PR-1 span family): the
+        # stage vocabulary is closed, so the five series exist — at
+        # zero — before the first connection. accept_stage_observe /
+        # accept_stage_merge dedup onto these instances via _get_named.
+        for st in ("acl", "classify", "backend_pick", "handover",
+                   "total"):
+            self.get_histogram("vproxy_accept_stage_us", stage=st)
+        # install/build latency histograms: eagerly created HERE (the
+        # reservoir config lives at this single site — _get_named's
+        # first-creation-wins rule means the component-side
+        # get_histogram calls in rules/engine.py and rules/maglev.py
+        # resolve to these instances)
+        self.get_histogram("vproxy_engine_swap_ms", reservoir=512)
+        self.get_histogram("vproxy_maglev_build_ms", reservoir=256)
 
     @staticmethod
     def _classify_stat(key: str) -> float:

@@ -48,3 +48,23 @@ def forward(path: str, n: int = 1) -> None:
 def rx(n: int) -> None:
     if n > 0:
         _ctr("vproxy_switch_rx_total").incr(n)
+
+
+# Pre-register the full reason/path vocabularies at import (the PR-9
+# silent-drops rule, enforced by tools/vlint's registry audit): a
+# scrape of a freshly-booted switch must show the ZEROS, so dashboards
+# can tell "no drops" from "drop counter not wired". Adding a new
+# reason literal at a call site without extending these tuples is a
+# vlint finding by construction — the audit's eager set is what a
+# fresh import of this module registers.
+DROP_REASONS = ("acl_deny", "arp_unresolved", "egress_short_write",
+                "route_miss", "same_iface", "unknown_vni")
+SLOWPATH_REASONS = ("bad_csum",)
+FORWARD_PATHS = ("fast", "slow")
+for _r in DROP_REASONS:
+    _ctr("vproxy_switch_drops_total", reason=_r)
+for _r in SLOWPATH_REASONS:
+    _ctr("vproxy_switch_slowpath_total", reason=_r)
+for _p in FORWARD_PATHS:
+    _ctr("vproxy_switch_forwards_total", path=_p)
+_ctr("vproxy_switch_rx_total")
