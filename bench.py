@@ -1861,6 +1861,42 @@ def _run_trace_stage(timeout):
     return {k: rep[k] for k in keys if k in rep}
 
 
+def _run_analytics_stage(timeout):
+    """bench_host.py --analytics in a CPU-env subprocess: the traffic-
+    analytics round (docs/observability.md). The FULL report — the
+    off-vs-on overhead pairs, both-plane top-table capture, the
+    seeded-Zipf sketch-accuracy rows — is the committed BENCH analytics
+    artifact; the orchestrator folds the headline gates in so every
+    future round carries them."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_analytics.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(
+        f"# === stage analytics (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--analytics"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "analytics", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage analytics: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    keys = ("analytics_overhead_off_vs_on", "analytics_overhead_pass",
+            "analytics_overhead_off_vs_absent",
+            "analytics_offcost_pass", "analytics_capture",
+            "analytics_capture_pass", "analytics_zipf",
+            "analytics_zipf_pass", "analytics_error")
+    return {k: rep[k] for k in keys if k in rep}
+
+
 def _run_static_analysis_stage():
     """tools/vlint over the tree, in-process (parse-only + one clean
     metrics-registry subprocess — seconds, not minutes): the finding
@@ -2100,6 +2136,10 @@ def orchestrate():
     result.update(_run_trace_stage(
         float(os.environ.get("BENCH_TRACE_TIMEOUT", "300"))))
     publish(result)
+    # traffic analytics: off-vs-on overhead gate + top-table capture
+    result.update(_run_analytics_stage(
+        float(os.environ.get("BENCH_ANALYTICS_TIMEOUT", "300"))))
+    publish(result)
     # static analysis: vlint finding counts by pass (invariant drift)
     result.update(_run_static_analysis_stage())
     publish(result)
@@ -2131,6 +2171,10 @@ if __name__ == "__main__":
     elif "--trace" in sys.argv:  # manual: just the tracing stage
         print(json.dumps(_run_trace_stage(
             float(os.environ.get("BENCH_TRACE_TIMEOUT", "300")))))
+        sys.exit(0)
+    elif "--analytics" in sys.argv:  # manual: just the analytics stage
+        print(json.dumps(_run_analytics_stage(
+            float(os.environ.get("BENCH_ANALYTICS_TIMEOUT", "300")))))
         sys.exit(0)
     elif "--static-analysis" in sys.argv:  # manual: just the vlint row
         print(json.dumps(_run_static_analysis_stage()))

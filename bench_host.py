@@ -684,6 +684,241 @@ def run_trace():
     return 0 if ok else 1
 
 
+def run_analytics():
+    """`--analytics`: the traffic-analytics rows (ISSUE 15,
+    docs/observability.md "traffic analytics").
+
+    1. **overhead gate** — interleaved PAIRED short-conn A/B on the
+       lanes path: analytics OFF vs ON (the per-accept cost is two
+       shard updates + the per-tick drain), median ratio over 7
+       alternating-order pairs, gate rps_off/rps_on <= 1.05. An
+       off-vs-absent pair rides along as the noise-floor calibration
+       (identical branch by construction, PR-13 discipline) with the
+       honest [0.8, 1.25] band.
+    2. **plane capture** — traffic through BOTH accept planes (C lanes
+       and lanes=0 python path) with analytics on: the top tables must
+       attribute the loopback client, the backend and both LBs, and
+       the per-dim snapshot lands in the artifact.
+    3. **seeded-Zipf accuracy** — the sketch contract measured
+       in-process: Space-Saving top-K superset of every key above
+       N/K, Count-Min never undercounting with >=95% of keys inside
+       e*N/width (the per-key probabilistic bound's quantile form).
+
+    The artifact is the committed BENCH_r14 analytics round."""
+    import random as _random
+
+    conns = _env_int("HOSTBENCH_CONNS", 32)
+    secs = float(os.environ.get("HOSTBENCH_SECS", "4"))
+    lanes_n = _env_int("HOSTBENCH_LANES", 4)
+    build_tool()
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                   ServerGroup)
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.net import vtl as _v
+    from vproxy_tpu.utils import sketch as SK
+
+    result = {"analytics_conns": conns, "analytics_secs": secs,
+              "analytics_lanes": lanes_n,
+              "analytics_native": _v.hh_supported()}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(out_path + ".tmp", out_path)
+
+    procs = []
+    lb = None
+    elg = None
+    groups = []
+    try:
+        p, bport = start_server()
+        procs.append(p)
+        elg = EventLoopGroup("w", 4)
+        hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1, down=2)
+        g = ServerGroup("g", elg, hc, "wrr")
+        groups.append(g)
+        g.add("b0", "127.0.0.1", bport, weight=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not any(s.healthy for s in g.servers):
+            time.sleep(0.05)
+        if not any(s.healthy for s in g.servers):
+            result["analytics_error"] = "backend never became healthy"
+            flush()
+            raise RuntimeError(result["analytics_error"])
+        ups = Upstream("u")
+        ups.add(g)
+
+        # ---- 1. overhead gate (off vs on, paired + interleaved) -----
+        lb = TcpLB("lb-hh", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=lanes_n)
+        lb.start()
+        result["analytics_lane_engine"] = (lb.lanes.engine()
+                                           if lb.lanes is not None
+                                           else "off")
+        run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+        rep_secs = max(2.0, secs / 2)
+
+        def _paired_ratios(knob_a, knob_b, reps=7):
+            # ratio = side_a rps / side_b rps per rep (a=off, b=on:
+            # >1 means the knob costs throughput), order alternating
+            ratios, raw = [], []
+            for rep in range(reps):
+                sides = [("a", knob_a), ("b", knob_b)]
+                if rep % 2:
+                    sides.reverse()
+                rr = {}
+                for name, knob in sides:
+                    SK.configure(on=knob)
+                    time.sleep(0.5)  # settle: drain the accept burst
+                    rr[name] = run_client(lb.bind_port, conns, rep_secs,
+                                          1, short=True)["rps"]
+                raw.append(rr)
+                ratios.append(rr["a"] / max(1.0, rr["b"]))
+            ratios.sort()
+            return ratios[len(ratios) // 2], raw
+
+        off_vs_absent, raw0 = _paired_ratios(False, False, reps=5)
+        off_vs_on, raw1 = _paired_ratios(False, True)
+        SK.configure(on=True)
+        result["analytics_overhead_off_vs_absent"] = round(
+            off_vs_absent, 3)
+        result["analytics_overhead_off_vs_on"] = round(off_vs_on, 3)
+        result["analytics_overhead_pairs"] = {"off_vs_absent": raw0,
+                                              "off_vs_on": raw1}
+        # the ISSUE gate: analytics ON costs <= 5% of lane short-conn
+        # throughput (median paired ratio; the true per-accept cost is
+        # two shard updates against a ~350us connection lifetime)
+        result["analytics_overhead_pass"] = bool(off_vs_on <= 1.05)
+        # knob-off zero-cost: off and absent are the same branch by
+        # construction — the pair is the noise-floor calibration
+        result["analytics_offcost_pass"] = bool(
+            0.8 <= off_vs_absent <= 1.25)
+        flush()
+
+        # ---- 2. plane capture (both accept planes) ------------------
+        SK.reset()
+        # DELTA, not the cumulative atomic: phase 1's overhead runs
+        # already drove the process-global counter into the thousands,
+        # so a broken phase-2 drain would still read > 0 from it
+        c_shard0 = _v.hh_counters()[0]
+        run_client(lb.bind_port, conns, rep_secs, 1, short=True)
+        time.sleep(0.5)  # lane 0's next tick folds the routes credit
+        lane_updates = _v.hh_counters()[0] - c_shard0
+        # drain evidence: the clients dim filled while the ONLY running
+        # LB was lane-served (python accepts == punts == 0), so every
+        # key arrived through vtl_hh_drain, not a python site
+        lane_drained = (sum(e["count"]
+                            for e in SK.top_table("clients", 0))
+                        if lb.accepted == 0 else 0)
+        lb.stop()
+        lb = None
+        lb = TcpLB("lb-hh-py", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=0)
+        lb.start()
+        run_client(lb.bind_port, conns, rep_secs, 1, short=True)
+        lb.stop()
+        lb = None
+        snap = SK.snapshot()
+        result["analytics_snapshot"] = snap
+        tops = snap["top"]
+        lane_ok = any(e["key"] == "lb-hh" for e in tops["routes"])
+        py_ok = any(e["key"] == "lb-hh-py" for e in tops["routes"])
+        client_ok = bool(tops["clients"]) and \
+            tops["clients"][0]["key"] == "127.0.0.1"
+        backend_ok = any(e["key"] == f"127.0.0.1:{bport}"
+                         for e in tops["backends"])
+        result["analytics_capture"] = {
+            "top_client_is_loopback": client_ok,
+            "backend_attributed": backend_ok,
+            "lane_lb_in_routes": lane_ok,
+            "py_lb_in_routes": py_ok,
+            "lane_shard_update_delta": lane_updates,
+            "lane_drained_client_count": lane_drained,
+            "shard_overflows": _v.hh_counters()[1],
+        }
+        result["analytics_capture_pass"] = bool(
+            client_ok and backend_ok and lane_ok and py_ok
+            and lane_updates > 0 and lane_drained > 0)
+        flush()
+
+        # ---- 3. seeded-Zipf accuracy (the sketch contract) ----------
+        rng = _random.Random(1414)
+        n_keys, n_events, k = 500, 30000, 32
+        keys = [f"198.51.{i // 250}.{i % 250}" for i in range(n_keys)]
+        weights = [1.0 / (i + 1) ** 1.2 for i in range(n_keys)]
+        stream = rng.choices(keys, weights=weights, k=n_events)
+        true = {}
+        for key in stream:
+            true[key] = true.get(key, 0) + 1
+        ws = SK.WindowedSketch("bench", window_s=1e9, k=k)
+        t0 = ws._rotate_at - ws.window_s
+        for key in stream:
+            ws.update(key, now=t0)
+        top_keys = {e["key"] for e in ws.top(now=t0)}
+        threshold = n_events / k
+        heavy = {key for key, c in true.items() if c > threshold}
+        missing = heavy - top_keys
+        cm = ws._cur[0]
+        bound = 2.72 * n_events / cm.width
+        over = under = 0
+        for key, t in true.items():
+            est = cm.estimate(key.encode())
+            if est < t:
+                under += 1
+            if est > t + bound:
+                over += 1
+        result["analytics_zipf"] = {
+            "events": n_events, "distinct": n_keys, "k": k,
+            "true_heavy_hitters": len(heavy),
+            "heavy_missing_from_topk": len(missing),
+            "cm_undercounts": under,
+            "cm_over_epsilon_keys": over,
+            "cm_epsilon_bound": round(bound, 1),
+            "top5": [{"key": e["key"], "count": e["count"],
+                      "err": e["err"],
+                      "true": true.get(e["key"], 0)}
+                     for e in ws.top(5, now=t0)],
+        }
+        result["analytics_zipf_pass"] = bool(
+            not missing and under == 0
+            and over <= 0.05 * len(true))
+        flush()
+    finally:
+        if lb is not None:
+            try:
+                lb.stop()
+            except Exception:
+                pass
+        for g_ in groups:
+            try:
+                g_.close()
+            except Exception:
+                pass
+        if elg is not None:
+            try:
+                elg.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(json.dumps(result))
+    flush()
+    ok = (result.get("analytics_overhead_pass", False)
+          and result.get("analytics_capture_pass", False)
+          and result.get("analytics_zipf_pass", False))
+    return 0 if ok else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
@@ -697,6 +932,8 @@ def main():
 
     if "--trace" in sys.argv[1:]:
         return run_trace()
+    if "--analytics" in sys.argv[1:]:
+        return run_analytics()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

@@ -50,6 +50,10 @@ REQUIRED_SYMBOLS = (
     # lane connections into vproxy_accept_stage_us
     "vtl_trace_rec_size", "vtl_trace_set_sample", "vtl_trace_set_ring_cap",
     "vtl_trace_drain", "vtl_trace_counters", "vtl_lanes_stage_stat",
+    # traffic-analytics HH shards (r14): per-lane sketch shards, the
+    # flow-cache hit drain, and the py==C hash parity surface
+    "vtl_hh_rec_size", "vtl_hh_set_enabled", "vtl_hh_hash",
+    "vtl_hh_counters", "vtl_hh_drain", "vtl_hh_flow_drain",
 )
 
 
@@ -83,7 +87,8 @@ def test_native_so_rebuilds_and_exports_current_abi():
                 "LANE_REC": lib.vtl_lane_rec_size,
                 "LANE_PUNT": lib.vtl_lane_punt_size,
                 "MAGLEV_REC": lib.vtl_maglev_rec_size,
-                "TRACE_REC": lib.vtl_trace_rec_size}
+                "TRACE_REC": lib.vtl_trace_rec_size,
+                "HH_REC": lib.vtl_hh_rec_size}
     assert set(size_fns) == set(model), \
         "a shared record gained/lost its vtl_*_rec_size guard — " \
         "update size_fns AND vlint's SHARED_RECORDS together"

@@ -251,6 +251,9 @@ def test_resp_matrix_covers_creatable_inventory():
         "trace",      # list-only span-trace buffer (utils/trace); the
                       # waterfall rides the bare `trace <id>` verb —
                       # exercised in tests/test_trace.py
+        "analytics",  # list-only heavy-hitter plane (utils/sketch);
+                      # per-dim tables ride the bare `top <dim>` verb —
+                      # exercised in tests/test_sketch.py
         # needs a booted cluster plane (VPROXY_TPU_CLUSTER_PEERS) this
         # clusterless matrix app doesn't have; the add/remove/list verbs
         # are exercised end-to-end in tests/test_cluster.py

@@ -29,7 +29,11 @@ def test_storm_smoke_flash_crowd():
     for mode in ("static", "adaptive"):
         r = rows[mode]
         assert r["fail"] == 0, r        # no hard session failures
-        assert set(r["slo"]) == {"p99_ms", "hard_failures", "served_rate"}
+        assert set(r["slo"]) == {"p99_ms", "hard_failures",
+                                 "served_rate", "crowd_in_top_clients"}
+        # the analytics plane saw the crowd: the blaster's source is
+        # the top client and the storm LB is attributed in top-routes
+        assert r["slo"]["crowd_in_top_clients"]["pass"], r["top_clients"]
     assert rows["static"]["ok"] > 0
     ad = rows["adaptive"]
     assert ad["ok"] > 0

@@ -448,7 +448,14 @@ def test_install_trace_brackets_unstalled_dispatch():
         qtids.append(qt)
         with trace.bind(qt):
             out = m.match([Hint(host="seed.example.com")])
-        assert int(out[0]) == 0
+        if int(out[0]) != 0:
+            # the swap publishes INSIDE set_rules, before done.set():
+            # a query landing in that window correctly answers -1
+            # against the NEW table (which has no seed rule). Legal
+            # only at the very end of the install — done must follow
+            # promptly; anything else is a real torn dispatch.
+            assert int(out[0]) == -1 and done.wait(5), out
+            break
     th.join(30)
     flush_installs(30)
     itids = [t["trace"] for t in trace.summaries(last=0)

@@ -157,9 +157,11 @@ def scenario_flash_crowd(scale: float = 1.0, seed: int = 0,
     ov.FLOOR, ov.TICK_MS = 6, 50
     ov.STALL_HI_MS, ov.ACCEPT_HI_MS = 50.0, 20.0
     rows = {}
+    from vproxy_tpu.utils import sketch
     try:
         for mode in ("static", "adaptive"):
             log(f"flash_crowd: {mode} run")
+            sketch.reset()  # per-mode window: the crowd must show NOW
             w = _LBWorld(f"storm-flash-{mode}", n_backends=2, workers=1,
                          overload=mode, max_sessions=4096)
             shed_ctr = _ctr("vproxy_lb_shed_total",
@@ -179,16 +181,29 @@ def scenario_flash_crowd(scale: float = 1.0, seed: int = 0,
                                         timeout=15)
                 ceiling = w.lb.overload_stat().get("ceiling")
                 guard = w.lb.overload_stat()
+                # analytics: the flash crowd must SHOW as a heavy
+                # hitter — the crowd's source in top-clients and the
+                # storm LB in top-routes (utils/sketch; the loopback
+                # blaster is one client address by construction)
+                top_clients = sketch.top_table("clients", 5)
+                top_routes = sketch.top_table("routes", 5)
             finally:
                 w.close()
             attempts = max(1, sessions // surge_clients) * surge_clients
             lat = surge.get("lat_s", [])
             p99_ms = _fleetlib.percentile(lat, 99) * 1000
+            crowd_seen = int(
+                not sketch.enabled()  # knob off: nothing to gate
+                or (bool(top_clients)
+                    and top_clients[0]["key"] == "127.0.0.1"
+                    and any(r["key"] == f"storm-flash-{mode}"
+                            for r in top_routes)))
             slo = {
                 "p99_ms": _gate(p99_ms, p99_limit_ms, "<="),
                 "hard_failures": _gate(surge["fail"], 0, "=="),
                 "served_rate": _gate(surge["ok"] / attempts,
                                      served_floor, ">="),
+                "crowd_in_top_clients": _gate(crowd_seen, 1, "=="),
             }
             rows[mode] = {
                 "mode": mode, "attempts": attempts, "ok": surge["ok"],
@@ -200,6 +215,7 @@ def scenario_flash_crowd(scale: float = 1.0, seed: int = 0,
                     2),
                 "final_ceiling": ceiling, "guard": guard,
                 "shed_counted": shed_ctr.value() - shed0,
+                "top_clients": top_clients, "top_routes": top_routes,
                 "slo": slo, "pass": _passed(slo),
             }
     finally:

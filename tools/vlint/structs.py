@@ -42,6 +42,7 @@ SHARED_RECORDS = {
     "LANE_PUNT": "LanePunt",
     "MAGLEV_REC": "MaglevRec",
     "TRACE_REC": "TraceRec",
+    "HH_REC": "HHRec",
 }
 
 # scalar C types we allow in shared records: name -> (size, kind)

@@ -63,9 +63,23 @@ class ClusterNode:
     # ------------------------------------------------------------- wiring
 
     def _hb_meta(self) -> dict:
-        return {"gen": self.replicator.generation,
+        meta = {"gen": self.replicator.generation,
                 "stepping": self.submit is not None
                 and not self.submit.degraded}
+        # gossip this node's analytics top-K (utils/sketch): any node's
+        # GET /analytics can then render the fleet-merged top table.
+        # ALWAYS present (possibly {}): an empty summary must OVERWRITE
+        # the peer's stored view, or a node whose burst aged out of its
+        # windows would haunt the fleet table forever
+        from ..utils import sketch
+        meta["hh"] = sketch.gossip_summary() if sketch.enabled() else {}
+        return meta
+
+    def fleet_analytics(self) -> dict:
+        """The fleet-merged top table: this node's live sketches +
+        every UP peer's gossiped summary."""
+        from ..utils import sketch
+        return sketch.fleet_table(self.membership.peer_analytics())
 
     def _on_generation(self, gen: int) -> None:
         # new rule generation == new step epoch: every host resets its

@@ -70,6 +70,10 @@ class Peer:
     stepping: bool = False  # participating in step-synchronized dispatch
     incarnation: float = 0.0  # peer's boot stamp (restart detection)
     last_rx: float = 0.0
+    # last analytics top-K summary gossiped in this peer's heartbeats
+    # ({dim: [[key, count], ...]}, utils/sketch.gossip_summary) — the
+    # fleet-merge input for GET /analytics on any node
+    hh: Optional[dict] = field(default=None, repr=False)
     _up_cnt: int = 0
     _down_cnt: int = 0
     _rx_since_tick: int = field(default=0, repr=False)
@@ -246,6 +250,15 @@ class Membership:
         with self._lock:
             return max((p.generation for p in self.peers.values()),
                        default=0)
+
+    def peer_analytics(self) -> dict:
+        """{node_id: gossiped top-K summary} for every UP peer that has
+        sent one (this node excluded — its live sketches are merged
+        directly, utils/sketch.fleet_table)."""
+        with self._lock:
+            return {p.node_id: p.hh for p in self.peers.values()
+                    if p.up and p.node_id != self.self_id
+                    and p.hh is not None}
 
     # ------------------------------------------------- maglev steering
 
@@ -438,6 +451,9 @@ class Membership:
             p.incarnation = inc
             p.generation = int(msg.get("gen", 0))
             p.stepping = bool(msg.get("stepping", False))
+            hh = msg.get("hh")
+            if isinstance(hh, dict):  # analytics top-K rides heartbeats
+                p.hh = hh
             p.last_rx = time.monotonic()
             p._rx_since_tick += 1
         if restarted is not None:

@@ -45,7 +45,7 @@ from typing import Optional
 
 from ..net import vtl
 from ..rules.ir import Proto
-from ..utils import events, failpoint, trace
+from ..utils import events, failpoint, sketch, trace
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
 from ..utils.metrics import accept_stage_merge
@@ -145,6 +145,9 @@ class AcceptLanes:
         # current python-side value so C lanes and python flip together
         # (trace.configure() pushes on later changes)
         vtl.trace_set_sample(trace.sample_every())
+        # same idiom for the analytics knob (the lane HH shards gate
+        # their per-accept work on one C atomic)
+        sketch.push_native_knob()
         self.handle = vtl.lanes_new(
             lb.bind_ip, lb.bind_port, 512, self.n, lb.in_buffer_size,
             self.uring, lb.timeout_ms, lb.connect_timeout_ms)
@@ -506,6 +509,7 @@ class AcceptLanes:
         # keep polling the real — possibly leaked — C object, never 0
         handle = self.handle
         last_accepted = 0
+        last_routed = 0  # routes-dim analytics credit (lane 0 only)
         while True:
             try:
                 punts = vtl.lane_poll(handle, idx, 1000)
@@ -524,6 +528,19 @@ class AcceptLanes:
                         if recs:
                             trace.ingest_lane_recs(recs)
                         if len(recs) < vtl._TRACE_DRAIN_MAX:
+                            break
+                except OSError:
+                    pass
+            if sketch.enabled() and vtl.hh_supported():
+                # drain THIS lane's analytics shard (same OS thread as
+                # the in-C producer — no concurrency by construction)
+                # until dry; knob-off cost is the enabled() branch
+                try:
+                    while True:
+                        recs = vtl.hh_drain(handle, idx)
+                        if recs:
+                            sketch.ingest_hh_recs(recs)
+                        if len(recs) < vtl._HH_DRAIN_MAX:
                             break
                 except OSError:
                     pass
@@ -550,6 +567,20 @@ class AcceptLanes:
                 if acc > last_accepted:
                     self.lb._retry_budget.on_accepts(acc - last_accepted)
                     last_accepted = acc
+                if acc > last_routed:
+                    # routes-dim credit for lane-owned traffic: the LB
+                    # alias keyed by the SAME punt/shed-adjusted delta
+                    # the retry budget uses — classic/stale punts land
+                    # in _on_accept (which credits the route itself,
+                    # so raw accepted would double-count them) and RST
+                    # sheds were never routed anywhere. The cursor
+                    # advances even with analytics OFF: a later enable
+                    # must not replay the whole off-period into one
+                    # window as a phantom rate spike.
+                    if sketch.enabled():
+                        sketch.update("routes", self.lb.alias,
+                                      acc - last_routed, plane="lane")
+                    last_routed = acc
             if punts is None:
                 return  # lanes_shutdown drained this lane
             for p in punts:
@@ -609,6 +640,14 @@ class AcceptLanes:
                 return
             # backend vanished from the tables since the entry compiled:
             # fall through — the classic path re-decides from scratch
+            # (its analytics dims were already tallied in C at pick
+            # time AND by lane 0's routes credit, so _on_accept must
+            # not count them again)
+            if not wl.run_on_loop(
+                    lambda: lb._on_accept(wl, fd, cip, cport, tid=tid,
+                                          hh_counted=True)):
+                vtl.close(fd)
+            return
         if not wl.run_on_loop(
                 lambda: lb._on_accept(wl, fd, cip, cport, tid=tid)):
             vtl.close(fd)

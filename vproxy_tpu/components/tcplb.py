@@ -26,7 +26,7 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
-from ..utils import events, failpoint, trace
+from ..utils import events, failpoint, sketch, trace
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
 from ..utils.metrics import accept_stage_observe
@@ -820,10 +820,13 @@ class TcpLB:
     # --------------------------------------------------------- data plane
 
     def _on_accept(self, loop, cfd: int, ip: str, port: int,
-                   tid: int = 0) -> None:
+                   tid: int = 0, hh_counted: bool = False) -> None:
         """tid: a nonzero trace id CONTINUES a trace begun in the C
         accept plane (a sampled lane punt); 0 lets this path make its
-        own 1-in-N sampling decision (utils/trace)."""
+        own 1-in-N sampling decision (utils/trace). hh_counted: the C
+        lane plane already tallied this accept's analytics dims (a
+        connect-fail punt whose backend vanished falls through here —
+        re-counting would double its client/route)."""
         if self.draining:
             # listener close raced an in-flight accept: shed it; the
             # drain contract only protects established sessions
@@ -854,6 +857,11 @@ class TcpLB:
             return
         self.accepted += 1
         self._retry_budget.on_accept()
+        # analytics (utils/sketch): who is hot right now — one branch
+        # per site when VPROXY_TPU_ANALYTICS=0
+        if not hh_counted:
+            sketch.update("clients", ip)
+            sketch.update("routes", self.alias)
         t_acc = time.monotonic()
         if tid == 0:
             tid = trace.maybe_sample()  # one branch when the knob is off
@@ -1363,6 +1371,12 @@ class TcpLB:
         if tried is None:
             tried = set()
         svr = target.svr
+        # analytics: backend attribution for every python-path handover
+        # (plain, pooled, fast-lane; lane-served sessions tally in C).
+        # The knob gate wraps the key build too — knob-off must not pay
+        # a string format per handover
+        if sketch.ON:
+            sketch.update("backends", f"{target.ip}:{target.port}")
         if not fresh:
             conn = self._pool_take(loop, target)
             if conn is not None:

@@ -43,6 +43,9 @@ class Upstream:
         self.alias = alias
         self.handles: list[GroupHandle] = []
         self._matcher = HintMatcher([], backend=backend)
+        # analytics attribution: the ClassifyService credits device
+        # launches/batch occupancy to this upstream by this name
+        self._matcher.owner_alias = alias
         self._wrr_seq: list[int] = []
         self._wrr_groups: list[GroupHandle] = []
         self._wrr_cursor = 0

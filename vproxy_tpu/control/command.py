@@ -71,6 +71,7 @@ TYPES = {
     "fault": "fault", "failpoint": "fault",
     "cluster-node": "cluster-node", "cn": "cluster-node",
     "trace": "trace",
+    "analytics": "analytics",
 }
 
 PARAM_KEYS = {
@@ -102,6 +103,7 @@ PARAM_KEYS = {
     "lanes": "lanes",
     "overload": "overload",
     "seed": "seed",
+    "plane": "plane",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -192,6 +194,32 @@ class Command:
             # /healthz to draining, let pumps finish, then main exits
             return app.request_drain()
         toks = line.split()
+        if toks and toks[0] == "top" and len(toks) <= 3:
+            # `top [clients|backends|routes|flows|qnames] [fleet]`: the
+            # heavy-hitter table of one dimension (utils/sketch), local
+            # or fleet-merged. Bare verb like `drain`/`trace <id>`;
+            # `list[-detail] analytics` is the full-surface view.
+            from ..utils import sketch as SK
+            if len(toks) == 1:
+                raise CmdError("top requires a dimension: "
+                               + "|".join(SK.DIMS))
+            dim = toks[1]
+            if dim not in SK.DIMS:
+                raise CmdError(f"unknown top dimension {dim!r} "
+                               f"(one of {', '.join(SK.DIMS)})")
+            if len(toks) == 3 and toks[2] != "fleet":
+                raise CmdError(f"unexpected token {toks[2]!r} "
+                               "(only `fleet`)")
+            if not SK.enabled():
+                return ["analytics disabled (VPROXY_TPU_ANALYTICS=0)"]
+            if len(toks) == 3:
+                cluster = getattr(app, "cluster", None)
+                if cluster is None:
+                    raise CmdError("no cluster plane booted; `top "
+                                   f"{dim}` serves the local view")
+                rows = cluster.fleet_analytics()[dim]
+                return SK.render_top(dim, rows)
+            return SK.render_top(dim)
         if len(toks) == 2 and toks[0] == "trace":
             # `trace <id>`: one sampled request's span waterfall (the
             # cross-plane attribution view — utils/trace). Bare verb
@@ -1279,11 +1307,15 @@ def _h_eventlog(app: Application, c: Command):
     connection lifecycle, loop stalls, classify failovers, health-check
     edges. list-detail returns the raw event dicts (what /events
     serves); list returns human-form lines."""
-    from ..utils.events import FlightRecorder
+    from ..utils.events import EVENT_PLANES, FlightRecorder
+    plane = c.params.get("plane")
+    if plane is not None and plane not in EVENT_PLANES:
+        raise CmdError(f"unknown event plane {plane!r} "
+                       f"(one of {', '.join(EVENT_PLANES)})")
     if c.action == "list":
-        return FlightRecorder.get().lines()
+        return FlightRecorder.get().lines(plane=plane)
     if c.action == "list-detail":
-        return FlightRecorder.get().snapshot()
+        return FlightRecorder.get().snapshot(plane=plane)
     raise CmdError(f"unsupported action {c.action} for event-log")
 
 
@@ -1301,6 +1333,30 @@ def _h_trace(app: Application, c: Command):
     if c.action == "list-detail":
         return TR.summaries()
     raise CmdError(f"unsupported action {c.action} for trace")
+
+
+def _h_analytics(app: Application, c: Command):
+    """`list analytics` — one summary line per dimension (top entry,
+    rate, update counts); `list-detail analytics` the full snapshot
+    dict (what GET /analytics serves). The per-dimension table is the
+    bare `top <dim>` verb."""
+    from ..utils import sketch as SK
+    if c.action == "list":
+        st = SK.status()
+        out = [f"analytics {'on' if st['enabled'] else 'off'} "
+               f"window={st['window_s']:g}s k={st['k']} "
+               f"cm={st['cm']['width']}x{st['cm']['depth']}"]
+        for d in SK.DIMS:
+            top = SK.top_table(d, 1)
+            ds = st["dims"][d]
+            lead = (f"#0 {top[0]['key']} count={top[0]['count']} "
+                    f"{top[0]['rate']:.1f}/s" if top else "(idle)")
+            out.append(f"{d}: updates={ds['updates']} "
+                       f"rotations={ds['rotations']} {lead}")
+        return out
+    if c.action == "list-detail":
+        return SK.snapshot_with_fleet()
+    raise CmdError(f"unsupported action {c.action} for analytics")
 
 
 def _h_fault(app: Application, c: Command):
@@ -1542,6 +1598,7 @@ _HANDLERS = {
     "fault": _h_fault,
     "event-log": _h_eventlog,
     "trace": _h_trace,
+    "analytics": _h_analytics,
     "cluster-node": _h_cluster,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,

@@ -194,6 +194,16 @@ class HttpController:
                             "traces": TR.summaries()})
 
         srv.get("/trace", trace_ep)
+
+        def analytics_ep(r: RoutingContext) -> None:
+            # heavy-hitter tables (docs/observability.md traffic
+            # analytics): local top-K per dimension + the fleet-merged
+            # view when a cluster is booted — same payload as the
+            # inspection server's /analytics (one shared assembly)
+            from ..utils import sketch as SK
+            r.resp.end(SK.snapshot_with_fleet())
+
+        srv.get("/analytics", analytics_ep)
         srv.post("/api/v1/command", self._command)
         srv.all("/api/v1/module/*", self._module)
         srv.listen(self.bind_port, self.bind_ip)

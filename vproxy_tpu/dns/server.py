@@ -28,6 +28,7 @@ from ..components.upstream import Upstream
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import Hint, Proto
+from ..utils import sketch
 from ..utils.ip import is_ip_literal, parse_ip
 from ..utils.log import Logger
 from . import packet as P
@@ -221,6 +222,11 @@ class DNSServer:
             self._respond(req, ip, port, [], rcode=1)
             return
         qs = list(req.questions)
+        # analytics: which qnames are hot (covers cache hits too — the
+        # whole point is seeing the crowd, cached or not)
+        if sketch.ON:
+            for q in qs:
+                sketch.update("qnames", q.qname, plane="dns")
         if len(qs) == 1 and self._cache_ms > 0:
             hit = self._cache_lookup(req, qs[0])
             if hit is not None:

@@ -35,6 +35,7 @@
 #include <deque>
 #include <memory>
 #include <mutex>
+#include <string>
 #include <unordered_map>
 #include <unordered_set>
 #include <algorithm>
@@ -1324,6 +1325,13 @@ int vtl_errno_eagain() { return EAGAIN; }
 // Table memory is only touched from the owning loop thread (poll +
 // install both run there); only the generation atomic crosses threads.
 
+// traffic-analytics knob + process tallies (the heavy-hitter plane,
+// full machinery below at "traffic analytics"): the flow cache's
+// per-entry hit tallies and the lanes' HH shards both gate on this one
+// relaxed load — knob-off cost on every C hot path is exactly that.
+static std::atomic<int> g_hh_on(0);
+static std::atomic<uint64_t> g_hh_updates(0), g_hh_overflow(0);
+
 #pragma pack(push, 1)
 struct FlowKey {          // 26 bytes; must match vtl.py FLOW_REC prefix
   uint32_t sender_ip;     // host-order u32 of the v4 sender addr
@@ -1371,6 +1379,11 @@ struct FlowEntry {
   uint16_t out_port;
   int32_t tap_fd;
   uint64_t gen, expire_us, last_hit_us;
+  // per-flow hit tally for the analytics plane (vtl_hh_flow_drain):
+  // bumped by probe hits (atomic relaxed — N poller threads), drained
+  // with exchange(0) by the switch's analytics tick. Like last_hit_us
+  // it is mutated from both sides, so it is atomic everywhere.
+  uint64_t hh_hits;
   // per-entry seqlock: the table is probed by N poller threads
   // (SO_REUSEPORT multiqueue) while the loop thread installs. Writers
   // (install only — probes never mutate entries beyond the benign
@@ -1392,6 +1405,9 @@ struct FlowCache {
   // per-table probe outcomes (the globals blend every switch in the
   // process; list-detail switch wants THIS switch's hit rate)
   std::atomic<uint64_t> hits{0}, misses{0};
+  // vtl_hh_flow_drain's walk cursor (one caller by contract: the
+  // owning switch's analytics tick)
+  uint64_t hh_cursor = 0;
 };
 
 // process-global counters (all switches), pump_counters idiom
@@ -1459,6 +1475,9 @@ VTL_NO_TSAN static void fc_racy_write(FlowEntry* dst, const FlowRec& rec,
   dst->gen = gen;
   dst->expire_us = expire;
   __atomic_store_n(&dst->last_hit_us, now, __ATOMIC_RELAXED);
+  // a reused slot must not credit the new flow with the old flow's
+  // pending analytics hits (one drain interval of misattribution)
+  __atomic_store_n(&dst->hh_hits, 0ull, __ATOMIC_RELAXED);
 }
 
 static uint64_t fc_hash(const FlowKey& k) {
@@ -1599,6 +1618,8 @@ static bool fc_probe(FlowCache* fc, const FlowKey& key, uint64_t cur,
     }
     if (now >= out->expire_us) return false;
     __atomic_store_n(&e.last_hit_us, now, __ATOMIC_RELAXED);
+    if (g_hh_on.load(std::memory_order_relaxed))
+      __atomic_fetch_add(&e.hh_hits, 1ull, __ATOMIC_RELAXED);
     return true;
   }
   return false;
@@ -2319,6 +2340,136 @@ int vtl_trace_counters(uint64_t* out) {
   return 2;
 }
 
+// ----------------------------------------------------- traffic analytics
+//
+// Heavy-hitter shards for the C planes (utils/sketch.py is the
+// process-wide sketch owner). Each accept lane owns one HHShard — a
+// small open-addressed (hash, key, count) table the lane thread updates
+// inline (client address + picked backend per accept, coalescing
+// repeats between drains); the lane's OWN python thread drains it
+// through vtl_hh_drain after each vtl_lane_poll return, so producer and
+// consumer are the same OS thread — no locks, no atomics, no races by
+// construction. The flow cache's per-entry hit tallies drain through
+// vtl_hh_flow_drain the same HH_REC shape. A full probe window bumps
+// the overflow counter and drops the update: counted, never silent,
+// never blocking the accept path. ONE hash contract: maglev_fnv64
+// (FNV-1a 64) over raw key bytes, exported as vtl_hh_hash so python
+// parity is testable bit for bit.
+
+// dim-id contract with net/vtl.py HH_DIMS (index == id)
+#define HH_DIM_CLIENT 0
+#define HH_DIM_BACKEND 1
+#define HH_DIM_FLOW 2
+#define HH_KEY_MAX 54
+#define HH_SHARD_SLOTS 512  // pow2; per-lane, drained every poll tick
+#define HH_PROBE 8
+
+#pragma pack(push, 1)
+struct HHRec {  // drain record; must match net/vtl.py HH_REC
+  uint64_t count;
+  uint32_t lane;
+  uint8_t dim;   // HH_DIM_*; contract with vtl.py HH_DIMS
+  uint8_t klen;
+  char key[54];  // raw client addr bytes / "ip:port" / FlowKey bytes
+};
+#pragma pack(pop)
+static_assert(sizeof(HHRec) == 68, "HHRec ABI drifted");
+
+struct HHSlot {
+  uint64_t hash = 0;
+  uint64_t count = 0;
+  uint8_t dim = 0, klen = 0;
+  char key[HH_KEY_MAX];
+};
+struct HHShard {
+  HHSlot slots[HH_SHARD_SLOTS];
+};
+
+int vtl_hh_rec_size(void) { return (int)sizeof(HHRec); }
+
+void vtl_hh_set_enabled(int on) {
+  g_hh_on.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+// the parity surface: python's sketch.fnv64 must agree bit for bit
+unsigned long long vtl_hh_hash(const void* p, int n) {
+  return maglev_fnv64((const uint8_t*)p, (size_t)(n > 0 ? n : 0));
+}
+
+// out[0] = shard updates absorbed, out[1] = probe-window overflows
+int vtl_hh_counters(uint64_t* out) {
+  out[0] = g_hh_updates.load(std::memory_order_relaxed);
+  out[1] = g_hh_overflow.load(std::memory_order_relaxed);
+  return 2;
+}
+
+static void hh_shard_update(HHShard* sh, uint8_t dim, const void* key,
+                            int klen, uint64_t w) {
+  if (klen <= 0) return;
+  if (klen > HH_KEY_MAX) klen = HH_KEY_MAX;  // truncate, both sides see
+                                             // the same truncated key
+  uint64_t h = maglev_fnv64((const uint8_t*)key, (size_t)klen) ^
+               ((uint64_t)(dim + 1) << 56);
+  if (!h) h = 1;
+  for (int i = 0; i < HH_PROBE; ++i) {
+    HHSlot& s = sh->slots[(h + (uint64_t)i) & (HH_SHARD_SLOTS - 1)];
+    if (s.count == 0) {
+      s.hash = h;
+      s.dim = dim;
+      s.klen = (uint8_t)klen;
+      memcpy(s.key, key, (size_t)klen);
+      s.count = w;
+      g_hh_updates.fetch_add(w, std::memory_order_relaxed);
+      return;
+    }
+    if (s.hash == h && s.dim == dim && s.klen == (uint8_t)klen &&
+        !memcmp(s.key, key, (size_t)klen)) {
+      s.count += w;
+      g_hh_updates.fetch_add(w, std::memory_order_relaxed);
+      return;
+    }
+  }
+  // probe window full between two drains: drop THIS update, loudly
+  g_hh_overflow.fetch_add(w, std::memory_order_relaxed);
+}
+
+// Drain one flow cache's pending per-entry hit tallies as HH_REC
+// records keyed by the 26-byte FlowKey. Resumes its walk across calls
+// (hh_cursor); one caller per cache by contract — the owning switch's
+// analytics tick. Entry keys are read under the per-entry seqlock
+// (fc_racy_copy) so a concurrent install never yields a torn key; a
+// slot moving mid-read keeps its tally for the next tick.
+int vtl_hh_flow_drain(void* fcp, void* out, int max) {
+  FlowCache* fc = (FlowCache*)fcp;
+  if (!fc || !out || max <= 0) return -EINVAL;
+  HHRec* o = (HHRec*)out;
+  int n = 0;
+  uint32_t cap = fc->mask + 1;
+  uint32_t step = 0;
+  for (; step < cap && n < max; ++step) {
+    FlowEntry& e = fc->slots[(fc->hh_cursor + step) & fc->mask];
+    if (!__atomic_load_n(&e.hh_hits, __ATOMIC_RELAXED)) continue;
+    uint32_t s1 = __atomic_load_n(&e.seq, __ATOMIC_ACQUIRE);
+    if (s1 & 1) continue;  // mid-install: pick it up next tick
+    FlowEntry copy;
+    fc_racy_copy(&copy, e);
+    __atomic_thread_fence(__ATOMIC_ACQUIRE);
+    if (__atomic_load_n(&e.seq, __ATOMIC_RELAXED) != s1) continue;
+    uint64_t pend = __atomic_exchange_n(&e.hh_hits, 0ull,
+                                        __ATOMIC_RELAXED);
+    if (!pend) continue;
+    o[n].count = pend;
+    o[n].lane = 0;
+    o[n].dim = HH_DIM_FLOW;
+    o[n].klen = (uint8_t)sizeof(FlowKey);
+    memset(o[n].key, 0, HH_KEY_MAX);
+    memcpy(o[n].key, &copy.key, sizeof(FlowKey));
+    ++n;
+  }
+  fc->hh_cursor += step;
+  return n;
+}
+
 // ---------------------------------------------------------- accept lanes
 //
 // The PR-5 switch-poller idiom applied to TCP: N lane threads (plain
@@ -2379,6 +2530,10 @@ struct LaneRoute {
   // accept, hash_port=0 for source-affinity groups
   std::vector<int32_t> maglev;
   int maglev_hash_port = 1;
+  // "ip:port" analytics keys, index-aligned with backends: precomputed
+  // at install so the accept path's HH update is a hash + memcpy, not
+  // a snprintf
+  std::vector<std::string> bkeys;
 };
 
 struct ConnMeta {  // per live lane pump (owning lane thread only)
@@ -2403,6 +2558,9 @@ struct Lane {
   std::unordered_map<uint64_t, ConnMeta> meta;
   uint64_t next_sweep_us = 0;
   TraceRing* tring = nullptr;  // SPSC span ring (this thread produces)
+  HHShard* hh = nullptr;       // analytics shard (this thread's alone:
+                               // produced in-poll, drained post-poll
+                               // by the SAME python thread)
 #ifndef VTL_NO_URING
   bool to_pending = false;  // outstanding IORING_OP_TIMEOUT
   struct { int64_t sec, nsec; } to_ts {0, 0};  // __kernel_timespec
@@ -2518,6 +2676,51 @@ int vtl_trace_drain(void* lp, int idx, void* out, int max) {
     ++h;
   }
   r->head.store(h, std::memory_order_release);
+  return n;
+}
+
+// per-accept analytics: client address + picked backend into this
+// lane's shard. Knob-off cost is the one relaxed load in the caller.
+static void lane_hh_note(Lane* ln, const sockaddr_storage* ss, int cfd,
+                         const LaneRoute* rt, int bidx) {
+  if (!ln->hh) return;
+  sockaddr_storage local;
+  if (!ss) {  // uring multishot accept reports no peer address
+    socklen_t sl = sizeof(local);
+    if (getpeername(cfd, (sockaddr*)&local, &sl) == 0) ss = &local;
+  }
+  uint8_t ipb[16];
+  int iplen = 0, cport = 0;
+  if (ss && maglev_addr_bytes(ss, ipb, &iplen, &cport))
+    hh_shard_update(ln->hh, HH_DIM_CLIENT, ipb, iplen, 1);
+  if (rt && bidx >= 0 && bidx < (int)rt->bkeys.size())
+    hh_shard_update(ln->hh, HH_DIM_BACKEND, rt->bkeys[bidx].data(),
+                    (int)rt->bkeys[bidx].size(), 1);
+}
+
+// Drain one lane's shard into `out` (HHRec array). Same-thread
+// contract as the shard updates: the lane's own python thread, after
+// its vtl_lane_poll returned — there is no concurrent producer.
+int vtl_hh_drain(void* lp, int idx, void* out, int max) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || idx < 0 || idx >= (int)ow->lanes.size() || !out || max <= 0)
+    return -EINVAL;
+  HHShard* sh = ow->lanes[idx]->hh;
+  if (!sh) return 0;
+  HHRec* o = (HHRec*)out;
+  int n = 0;
+  for (int i = 0; i < HH_SHARD_SLOTS && n < max; ++i) {
+    HHSlot& s = sh->slots[i];
+    if (!s.count) continue;
+    o[n].count = s.count;
+    o[n].lane = (uint32_t)idx;
+    o[n].dim = s.dim;
+    o[n].klen = s.klen;
+    memset(o[n].key, 0, HH_KEY_MAX);
+    memcpy(o[n].key, s.key, s.klen);
+    ++n;
+    s.count = 0;  // slot reclaimed; undrained slots keep their tallies
+  }
   return n;
 }
 
@@ -2637,14 +2840,18 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   }
   uint64_t t_pick0 = mono_ns();
   int bidx;
+  // function-scope storage for a late-resolved peer address: `ss` may
+  // be re-pointed at it inside the maglev branch and is read after the
+  // branch ends (lane_hh_note, the connect-fail punt) — a block-local
+  // would leave those reads dangling
+  sockaddr_storage peer;
   if (!rt->maglev.empty()) {
     // consistent-hash pick: one FNV over the client addr (+port when
     // per-connection spread is configured) + one table load. The uring
     // multishot accept reports no peer address — resolve it here.
-    sockaddr_storage local;
     if (!ss) {
-      socklen_t sl = sizeof(local);
-      if (getpeername(cfd, (sockaddr*)&local, &sl) == 0) ss = &local;
+      socklen_t sl = sizeof(peer);
+      if (getpeername(cfd, (sockaddr*)&peer, &sl) == 0) ss = &peer;
     }
     uint8_t ipb[16];
     int iplen = 0, cport = 0;
@@ -2673,6 +2880,8 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   }
   uint64_t t_pick1 = mono_ns();
   lanes_stage_obs(ow, LANE_STAGE_PICK, (t_pick1 - t_pick0) / 1000);
+  if (g_hh_on.load(std::memory_order_relaxed))
+    lane_hh_note(ln, ss, cfd, rt.get(), bidx);
   if (tid) {
     lane_trace(ln, tid, TR_ACCEPT, t_acc, t_pick0 - t_acc, 0, 0);
     lane_trace(ln, tid, TR_PICK, t_pick0, t_pick1 - t_pick0,
@@ -3069,6 +3278,7 @@ void* vtl_lanes_new(const char* ip, int port, int backlog, int nlanes,
     ln->loop = lane_loop_new(uring);
     ln->tring = new TraceRing(
         g_trace_ring_cap.load(std::memory_order_relaxed));
+    ln->hh = new HHShard();
     if (i == 0 && uring && !ln->loop->ur) uring = false;  // setup refused
     Handler* h = new Handler{Handler::LANE, (uint64_t)i, nullptr, lfd,
                              (uint32_t)-1};
@@ -3137,6 +3347,10 @@ int vtl_lane_install(void* lp, const void* recs, int n,
     rt->backends.push_back(r[i]);
     rt->addrs.push_back(ss);
     rt->lens.push_back(sl);
+    char kb[64];
+    int kl = snprintf(kb, sizeof(kb), "%s:%u", ipb,
+                      (unsigned)r[i].port);
+    rt->bkeys.emplace_back(kb, (size_t)(kl > 0 ? kl : 0));
   }
   for (int j = 0; j < nseq; ++j)
     if (seq[j] >= 0 && seq[j] < n && remap[seq[j]] >= 0)
@@ -3180,6 +3394,10 @@ int vtl_lane_maglev_install(void* lp, const void* recs, int n,
     rt->backends.push_back(lr);
     rt->addrs.push_back(ss);
     rt->lens.push_back(sl);
+    char kb[64];
+    int kl = snprintf(kb, sizeof(kb), "%s:%u", ipb,
+                      (unsigned)r[i].port);
+    rt->bkeys.emplace_back(kb, (size_t)(kl > 0 ? kl : 0));
   }
   rt->maglev.resize((size_t)m, -1);
   for (int j = 0; j < m; ++j)
@@ -3293,6 +3511,7 @@ int vtl_lanes_free(void* lp) {
     if (ln->lfd >= 0) close(ln->lfd);
     vtl_free(ln->loop);
     delete ln->tring;
+    delete ln->hh;
     delete ln;
   }
   delete ow;

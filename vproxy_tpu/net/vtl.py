@@ -169,6 +169,16 @@ def _load() -> ctypes.CDLL:
         lib.vtl_lanes_stage_stat.argtypes = [p, c, ctypes.POINTER(u64)]
     except AttributeError:
         pass
+    try:  # traffic-analytics HH shards (absent from a pre-r14 .so)
+        lib.vtl_hh_rec_size.argtypes = []
+        lib.vtl_hh_set_enabled.argtypes = [c]
+        lib.vtl_hh_hash.argtypes = [ctypes.c_char_p, c]
+        lib.vtl_hh_hash.restype = u64
+        lib.vtl_hh_counters.argtypes = [ctypes.POINTER(u64)]
+        lib.vtl_hh_drain.argtypes = [p, c, ctypes.c_void_p, c]
+        lib.vtl_hh_flow_drain.argtypes = [p, ctypes.c_void_p, c]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -1026,6 +1036,118 @@ def trace_drain(handle: int, idx: int, maxrecs: int = _TRACE_DRAIN_MAX):
         check(n)
     return [TRACE_REC.unpack_from(buf, i * TRACE_REC.size)
             for i in range(n)]
+
+
+# ----------------------------------------------------- traffic analytics
+#
+# The C planes' heavy-hitter shards (native/vtl.cpp "traffic
+# analytics"; utils/sketch.py owns the process-wide sketches): each
+# accept lane coalesces (client, backend) observations into a lane-owned
+# shard drained by that lane's OWN python thread (same OS thread as the
+# producer — no concurrency), and the flow cache's per-entry hit
+# tallies drain the same HH_REC shape. One hash contract: FNV-1a 64
+# (vtl_hh_hash == sketch.fnv64, parity-tested).
+
+# count u64, lane u32, dim u8, klen u8, key 54s — must match the C HHRec
+HH_REC = struct.Struct("<QIBB54s")
+HH_REC_FIELDS = ("count", "lane", "dim", "klen", "key")
+# dim-index contract with the C HH_DIM_* defines (index == id); these
+# map onto utils/sketch.DIMS entries of the same name
+HH_DIMS = ("clients", "backends", "flows")
+
+_hh_supported: bool = None  # type: ignore[assignment]
+
+
+def hh_supported() -> bool:
+    """Native provider with the analytics symbols AND a matching drain-
+    record ABI (a stale committed .so fails the size check and the C
+    planes silently contribute nothing — python-plane analytics still
+    work)."""
+    global _hh_supported
+    if _hh_supported is None:
+        ok = PROVIDER == "native" and hasattr(LIB, "vtl_hh_drain")
+        if ok:
+            try:
+                ok = int(LIB.vtl_hh_rec_size()) == HH_REC.size
+            except Exception:
+                ok = False
+        _hh_supported = ok
+    return _hh_supported
+
+
+def hh_set_enabled(on: bool) -> None:
+    """Flip the one C analytics atomic (lanes + flow cache gate their
+    per-event work on it). No-op on a .so without the surface."""
+    fn = getattr(LIB, "vtl_hh_set_enabled", None)
+    if fn is not None:
+        fn(1 if on else 0)
+
+
+def hh_hash(key: bytes) -> int:
+    """The C-side FNV-1a 64 over raw key bytes — the py==C parity
+    surface for utils/sketch.fnv64. Raises on a .so without it."""
+    return int(LIB.vtl_hh_hash(bytes(key), len(key)))
+
+
+def hh_counters() -> tuple:
+    """(shard_updates, probe_window_overflows) — process-global C
+    atomics; zeros without the analytics surface."""
+    fn = getattr(LIB, "vtl_hh_counters", None)
+    if fn is None or PROVIDER != "native":
+        return (0, 0)
+    out = (ctypes.c_uint64 * 2)()
+    fn(out)
+    return (int(out[0]), int(out[1]))
+
+
+_HH_DRAIN_MAX = 256
+_hh_tls = None  # per-thread drain buffers (each lane thread's own)
+
+
+def _hh_buf():
+    global _hh_tls
+    if _hh_tls is None:
+        import threading
+        _hh_tls = threading.local()
+    buf = getattr(_hh_tls, "buf", None)
+    if buf is None:
+        buf = _hh_tls.buf = ctypes.create_string_buffer(
+            HH_REC.size * _HH_DRAIN_MAX)
+    return buf
+
+
+def _hh_unpack(buf, n: int) -> list:
+    out = []
+    for i in range(n):
+        count, lane, dim, klen, key = HH_REC.unpack_from(
+            buf, i * HH_REC.size)
+        out.append((count, lane, dim, key[:klen]))
+    return out
+
+
+def hh_drain(handle: int, idx: int, maxrecs: int = _HH_DRAIN_MAX):
+    """Drain one lane's analytics shard -> [(count, lane, dim,
+    key_bytes), ...]. Same-thread contract as the shard's producer: the
+    lane's own python thread, after its vtl_lane_poll returned."""
+    buf = _hh_buf()
+    n = LIB.vtl_hh_drain(handle, idx, buf, min(maxrecs, _HH_DRAIN_MAX))
+    if n < 0:
+        check(n)
+    return _hh_unpack(buf, n)
+
+
+def hh_flow_drain(handle: int, maxrecs: int = _HH_DRAIN_MAX):
+    """Drain a flow cache's pending per-flow hit tallies (dim=flows,
+    key = the 26-byte FlowKey). One caller per cache by contract — the
+    owning switch's analytics tick; resumes its walk across calls."""
+    fn = getattr(LIB, "vtl_hh_flow_drain", None)
+    if fn is None:
+        return []
+    buf = _hh_buf()
+    n = fn(handle, buf, min(maxrecs, _HH_DRAIN_MAX))
+    if n < 0:
+        check(n)
+    return _hh_unpack(buf, n)
 
 
 def lanes_stage_stat(handle: int, stage: int) -> tuple:

@@ -94,7 +94,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from ..utils import trace
+from ..utils import sketch, trace
 from ..utils.log import Logger
 from .engine import SMALL_TABLE, pad_batch
 from .ir import Hint
@@ -584,6 +584,14 @@ class ClassifyService:
             self.stats.max_batch = max(self.stats.max_batch, n)
         snap = matcher.snapshot()  # ONE generation for device/oracle/payload
         lone_big = n == 1 and matcher.size() > SMALL_TABLE
+        if sketch.ON:
+            # device-plane attribution: which upstream's classify load
+            # is filling the batches (routes dim, `upstream:<alias>`
+            # keys, weight = batch occupancy)
+            own = getattr(matcher, "owner_alias", None)
+            if own:
+                sketch.update("routes", f"upstream:{own}", n,
+                              plane="engine")
         # sampled requests in the batch: batch-shared phases (dispatch,
         # d2h sync, host_index) attach to the FIRST one — one span, not
         # one per request; per-request queue wait is recorded for every

@@ -28,6 +28,37 @@ from typing import Optional
 
 DEFAULT_CAPACITY = 1024
 
+# kind -> plane classification for `GET /events?plane=` and
+# `list event-log plane=<p>` (the analytics drill-down: jump from a hot
+# client in `top clients` to its accept-plane events without wading
+# through cluster gossip). An event may carry an explicit plane= field
+# to override; unmapped kinds land in "app".
+EVENT_PLANES = ("accept", "lane", "engine", "cluster", "loop", "app")
+_KIND_PLANE = {
+    "conn": "accept", "conn_denied": "accept", "drain": "accept",
+    "drain_shed": "accept", "overload": "accept",
+    "overload_mode": "accept", "halfopen_shed": "accept",
+    "retry": "accept", "eject": "accept", "eject_skipped": "accept",
+    "readmit": "accept", "hc_up": "accept", "hc_down": "accept",
+    "lanes": "lane",
+    "classify_failover": "engine",
+    "peer_up": "cluster", "peer_down": "cluster",
+    "cluster_degrade": "cluster", "cluster_rejoin": "cluster",
+    "cluster_steer_rebuild": "cluster",
+    "generation_bump": "cluster", "generation_install": "cluster",
+    "generation_reject": "cluster", "generation_discard": "cluster",
+    "loop_stall": "loop",
+}
+
+
+def plane_of(ev: dict) -> str:
+    """The plane an event belongs to: its explicit plane= field when
+    one was recorded, else the kind classification, else "app"."""
+    p = ev.get("plane")
+    if p:
+        return p
+    return _KIND_PLANE.get(ev.get("kind", ""), "app")
+
 
 class FlightRecorder:
     _instance: Optional["FlightRecorder"] = None
@@ -70,19 +101,23 @@ class FlightRecorder:
                 self.dropped += 1
             self._ring.append(ev)
 
-    def snapshot(self, last: int = 0, trace: Optional[int] = None) -> list:
+    def snapshot(self, last: int = 0, trace: Optional[int] = None,
+                 plane: Optional[str] = None) -> list:
         """Events oldest-first; `last` > 0 trims to the newest N;
-        `trace` filters to events carrying that trace_id."""
+        `trace` filters to events carrying that trace_id; `plane`
+        filters by plane_of() classification."""
         with self._lock:
             evs = list(self._ring)
         if trace is not None:
             evs = [e for e in evs if e.get("trace_id") == trace]
+        if plane is not None:
+            evs = [e for e in evs if plane_of(e) == plane]
         return evs[-last:] if last > 0 else evs
 
-    def lines(self, last: int = 0) -> list:
+    def lines(self, last: int = 0, plane: Optional[str] = None) -> list:
         """Human-form rendering for the command surface."""
         out = []
-        for ev in self.snapshot(last):
+        for ev in self.snapshot(last, plane=plane):
             extras = " ".join(
                 f"{k}={ev[k]}" for k in sorted(ev)
                 if k not in ("seq", "ts", "mono", "kind", "msg"))

@@ -374,6 +374,42 @@ class GlobalInspection:
                               self._trace_c_drops, ring="lane")
         self.registry.gauge_f("vproxy_trace_drop_total",
                               self._trace_py_drops, ring="py")
+        # traffic-analytics plane (utils/sketch + native HH shards):
+        # pre-registered with CLOSED label vocabularies (the PR-13
+        # registry rule) — vproxy_hh_count{dim,slot} exposes the top-K
+        # table slots per dimension, the counters account every update
+        # plane and every lossy path (shard overflow, fleet-merge
+        # truncation) so a scrape distinguishes "no traffic" from
+        # "analytics off" from "dropped"
+        from . import sketch as _sketch
+        for dim in _sketch.DIMS:
+            for slot in range(_sketch.TOP_SLOTS):
+                self.registry.gauge_f(
+                    "vproxy_hh_count",
+                    lambda dim=dim, slot=slot: _sketch.top_slot(dim,
+                                                                slot),
+                    dim=dim, slot=str(slot))
+        for pl in _sketch.PLANES:
+            self.registry.gauge_f(
+                "vproxy_analytics_updates_total",
+                lambda pl=pl: float(_sketch.plane_updates_total(pl)),
+                plane=pl)
+        self.registry.gauge_f("vproxy_analytics_drop_total",
+                              self._hh_overflow, reason="shard_overflow")
+        # merge_truncated is the LATEST fleet merge's beyond-top-table
+        # row count (a level, not a lifetime total — fleet merges run
+        # per render, so a cumulative tally would track dashboard poll
+        # rate instead of data loss)
+        self.registry.gauge_f(
+            "vproxy_analytics_drop_total",
+            lambda: float(_sketch.merge_truncated_last()),
+            reason="merge_truncated")
+        self.registry.gauge_f(
+            "vproxy_analytics_rotations_total",
+            lambda: float(_sketch.rotations_total()))
+        self.registry.gauge_f(
+            "vproxy_analytics_enabled",
+            lambda: 1.0 if _sketch.enabled() else 0.0)
         # silent-drop accounting (udp_drop_incr below): created eagerly
         # so a scrape shows the zero before the first drop
         self.get_counter("vproxy_udp_drop_total")
@@ -460,6 +496,11 @@ class GlobalInspection:
     def _trace_py_drops() -> float:
         from . import trace
         return float(trace.py_dropped_total())
+
+    @staticmethod
+    def _hh_overflow() -> float:
+        from ..net import vtl
+        return float(vtl.hh_counters()[1])
 
     def _loop_health(self, key: str) -> float:
         with self._lock:
@@ -651,9 +692,22 @@ def launch_inspection_http(loop, ip: str, port: int):
             tid = int(ctx.req.query.get("trace", "0"))
         except ValueError:
             tid = 0
-        ctx.resp.end(FlightRecorder.get().snapshot(last, trace=tid or None))
+        # ?plane=<p>: only events of that plane (utils/events.plane_of
+        # — the analytics drill-down filter)
+        plane = ctx.req.query.get("plane") or None
+        ctx.resp.end(FlightRecorder.get().snapshot(last, trace=tid or None,
+                                                   plane=plane))
 
     srv.get("/events", events)
+
+    def analytics(ctx) -> None:
+        # the heavy-hitter plane (utils/sketch): local top tables +
+        # the fleet-merged view when a cluster is booted (one shared
+        # assembly across all three serving surfaces)
+        from . import sketch as SK
+        ctx.resp.end(SK.snapshot_with_fleet())
+
+    srv.get("/analytics", analytics)
 
     def trace_ep(ctx) -> None:
         # GET /trace -> recent trace summaries; ?id=<trace> -> that

@@ -115,6 +115,7 @@ class Switch:
         self._pollers_stop = False
         self._fd: Optional[int] = None
         self._sweeper = None
+        self._hh_task = None  # analytics flow-drain periodic
         self.started = False
 
     # ------------------------------------------------------------ control
@@ -141,6 +142,10 @@ class Switch:
         ttl = int(_os.environ.get("VPROXY_TPU_FLOWCACHE_TTL_MS", "10000"))
         self._fc = vtl.flowcache_new(size, ttl)
         self._fc_active = True
+        # analytics: the flow cache's per-entry hit tallies gate on the
+        # same C atomic as the lane shards — push the current knob
+        from ..utils import sketch
+        sketch.push_native_knob()
 
     def flow_handle(self):
         """C flow-table handle for the fast path's entry compiler, or
@@ -159,8 +164,25 @@ class Switch:
         self._fc_active = bool(on) and self._fc is not None
         if self._fc_active and self.started:
             self._start_pollers()
+            self._arm_hh_task()  # a cache created by THIS hot-enable
+            # missed _bind's arming — without this the per-entry hit
+            # tallies would accumulate with no drain forever
         elif not self._fc_active:
             self._stop_pollers()
+
+    def _arm_hh_task(self) -> None:
+        """Arm the analytics flow-drain periodic (idempotent; on the
+        owning loop). The tick itself gates on sketch.enabled()."""
+        if self._fc is None or not vtl.hh_supported():
+            return
+        from ..utils import sketch
+
+        def arm() -> None:
+            if self._hh_task is None and self._fc is not None:
+                self._hh_task = self.loop.period(
+                    max(500, int(sketch.WINDOW_S * 250)),
+                    self._hh_flow_tick)
+        self.loop.run_on_loop(arm)
 
     # ------------------------------------------------ multiqueue pollers
 
@@ -247,6 +269,25 @@ class Switch:
         finally:
             vtl.close(fd)
 
+    def _hh_flow_tick(self) -> None:
+        """Fold the C flow-table hit tallies into the flows dimension
+        (utils/sketch). Bounded: at most 8 drain calls per tick — the
+        cursor resumes next tick; each call is one quick C walk."""
+        from ..net.vtl import _HH_DRAIN_MAX, hh_flow_drain
+        from ..utils import sketch
+        fc = self._fc
+        if fc is None or not sketch.enabled():
+            return
+        try:
+            for _ in range(8):
+                recs = hh_flow_drain(fc)
+                if recs:
+                    sketch.ingest_hh_recs(recs)
+                if len(recs) < _HH_DRAIN_MAX:
+                    break
+        except OSError:
+            pass
+
     def _gen_bump(self, *_a) -> None:
         """Every route/ACL/MAC/ARP/owned-ip/iface mutation lands here:
         one C atomic bump invalidates every installed flow entry (probe
@@ -298,6 +339,20 @@ class Switch:
             loop.add(self._fd, vtl.EV_READ, self._on_readable)
             self._sweeper = loop.period(IFACE_TIMEOUT_MS // 4,
                                         self._sweep_ifaces)
+            from ..utils import sketch
+            if self._fc is not None and vtl.hh_supported():
+                # analytics tick: drain the C per-flow hit tallies into
+                # the flows dimension (a fraction of the window so the
+                # epoch rotation sees fresh counts). Armed regardless
+                # of the CURRENT knob — the tick itself gates on
+                # sketch.enabled(), so a runtime configure(True) starts
+                # flowing without a rebind (a boot-time-only gate left
+                # the flows dim permanently empty after a late enable).
+                # set_flowcache(True) arms via _arm_hh_task for caches
+                # created after boot.
+                self._hh_task = loop.period(
+                    max(500, int(sketch.WINDOW_S * 250)),
+                    self._hh_flow_tick)
         try:
             loop.call_sync(mk)
         except OSError as e:
@@ -321,6 +376,7 @@ class Switch:
             return
         self._fd = None
         self._sweeper = None
+        self._hh_task = None  # died with the loop; _bind re-arms it
         for key, (iface, ts) in list(self.ifaces.items()):
             if isinstance(iface, TapIface):
                 del self.ifaces[key]
@@ -351,11 +407,14 @@ class Switch:
     def _undo_rehome_bind(self) -> None:
         fd, self._fd = self._fd, None
         sweeper, self._sweeper = self._sweeper, None
+        hh_task, self._hh_task = self._hh_task, None
         lp2 = self.loop
 
         def rm() -> None:
             if sweeper is not None:
                 sweeper.cancel()
+            if hh_task is not None:
+                hh_task.cancel()
             if fd is not None:
                 lp2.remove(fd)
                 vtl.close(fd)
@@ -379,6 +438,9 @@ class Switch:
         def rm() -> None:
             if self._sweeper is not None:
                 self._sweeper.cancel()
+            if self._hh_task is not None:
+                self._hh_task.cancel()
+                self._hh_task = None
             for iface, _ in list(self.ifaces.values()):
                 iface.close()
             self.ifaces.clear()
