@@ -1,0 +1,277 @@
+"""Scenario drive: workload capture + record-replay (the verify-skill
+recipe, round 17 — docs/replay.md is the runbook).
+
+Covers: a grammar-built lanes LB whose lane-served traffic fills the
+`lane` arrival plane and the per-LB conn histograms with ZERO python
+accepts (the vtl_lanes_capture_stat delta fold), the python accept and
+DNS planes, the `capture start|stop|export` verbs via Command.execute
+with window-scoped deltas, `GET /workload` on the HTTP controller
+parsing back through WorkloadModel.from_json, the new metric families,
+`list event-log since= until=` + `GET /events?since=&until=` range
+joins on the capture window's own clock, the full record→replay→
+fidelity loop (seeded Zipf mix through a real LB, byte-identical
+schedule hash in-process AND from a subprocess `--hash-only`, replay
+report SLO + fidelity gates green), the capacity-planning row, and the
+knob-off zero-cost check (C lane capture counter and python cursors
+FROZEN across 20 sessions; re-enable resumes).
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_workload.py
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import CmdError, Command
+from vproxy_tpu.control.http_controller import HttpController
+from vproxy_tpu.net import vtl
+from vproxy_tpu.utils import lifecycle, metrics, sketch, workload
+from vproxy_tpu.utils.events import FlightRecorder
+from vproxy_tpu.utils.workload import WorkloadModel
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "tools"))
+import replay  # noqa: E402
+
+
+class IdSrv:
+    def __init__(self, ident):
+        self.ident = ident.encode()
+        self.s = socket.socket()
+        self.s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.s.bind(("127.0.0.1", 0))
+        self.s.listen(64)
+        self.port = self.s.getsockname()[1]
+        import threading
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                c, _ = self.s.accept()
+            except OSError:
+                return
+            try:
+                c.sendall(self.ident)
+                c.close()
+            except OSError:
+                pass
+
+
+def get_id(port):
+    c = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c.settimeout(5)
+    sid = c.recv(16)
+    c.close()
+    return sid.decode()
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def main():
+    assert workload.enabled(), "set VPROXY_TPU_WORKLOAD=1 for the drive"
+    assert sketch.enabled(), "popularity fitting needs the sketches"
+    lifecycle.reset()
+    sketch.reset()
+    workload.reset()
+    app = Application.create(workers=2)
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    srv = IdSrv("A")
+    for cmd in (
+            "add upstream u0",
+            "add server-group g0 timeout 500 period 100 up 1 down 1",
+            "add server-group g0 to upstream u0 weight 10",
+            f"add server sA to server-group g0 address "
+            f"127.0.0.1:{srv.port} weight 10"):
+        assert Command.execute(app, cmd) == "OK", cmd
+    g = app.server_groups["g0"]
+    assert wait_for(lambda: any(s.healthy for s in g.servers))
+    assert Command.execute(
+        app, "add tcp-lb lb0 address 127.0.0.1:0 upstream u0 "
+        "protocol tcp lanes 2") == "OK"
+    assert Command.execute(
+        app, "add tcp-lb lb1 address 127.0.0.1:0 upstream u0 "
+        "protocol tcp") == "OK"
+    lb, lb1 = app.tcp_lbs["lb0"], app.tcp_lbs["lb1"]
+    assert lb.lanes is not None and lb1.lanes is None
+
+    # ---- capture window via the operator grammar ------------------
+    st = Command.execute(app, "capture status")
+    assert any("idle" in line for line in st), st
+    t_open = time.monotonic_ns()
+    assert Command.execute(app, "capture start")
+    for _ in range(20):
+        assert get_id(lb.bind_port) == "A"   # lane-served
+    for _ in range(10):
+        assert get_id(lb1.bind_port) == "A"  # python accept path
+    assert lb.accepted == 0, "python accept path fired on the lanes LB"
+    # the lane fold rides lane 0's poll tick
+    assert wait_for(lambda: workload._hist("lane").state()[0] >= 19)
+    from vproxy_tpu.dns import packet as P
+    assert Command.execute(
+        app, "add dns-server dns0 address 127.0.0.1:0 upstream u0"
+    ) == "OK"
+    d = app.dns_servers["dns0"]
+    q = P.Packet(id=7, questions=[P.Question("cap.example.com.", P.A)])
+    tx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    for _ in range(6):
+        tx.sendto(q.encode(), ("127.0.0.1", d.bind_port))
+    tx.close()
+    assert wait_for(lambda: workload._hist("dns").state()[0] >= 5)
+    assert Command.execute(app, "capture stop")
+    t_close = time.monotonic_ns()
+    blob = Command.execute(app, "capture export seed=7")[0]
+    model = WorkloadModel.from_json(blob)
+    assert model.seed == 7
+    pl = model.data["planes"]
+    assert pl["lane"]["arrivals"] >= 19 and pl["lane"]["rate_hz"] > 0
+    assert pl["accept"]["arrivals"] >= 9
+    assert pl["dns"]["arrivals"] >= 5
+    assert model.data["conn"]["bytes"]["count"] >= 30
+    hb0, _hd0 = metrics.conn_hists("lb0")
+    hb1, _hd1 = metrics.conn_hists("lb1")
+    assert hb0.state()[0] >= 20 and hb1.state()[0] >= 10
+    try:
+        Command.execute(app, "capture bogus")
+        raise AssertionError("bad capture verb accepted")
+    except CmdError:
+        pass
+    print(f"# capture: lane={pl['lane']['arrivals']} (0 python "
+          f"accepts) accept={pl['accept']['arrivals']} "
+          f"dns={pl['dns']['arrivals']} conn_bytes="
+          f"{model.data['conn']['bytes']['count']} — window-scoped, "
+          f"seed=7 embedded")
+
+    # ---- HTTP surfaces + metric families --------------------------
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ctl.bind_port}/workload",
+            timeout=5) as r:
+        live = WorkloadModel.from_json(r.read().decode())
+    assert live.data["planes"]["lane"]["arrivals"] >= 19
+    text = metrics.GlobalInspection.get().prometheus_string()
+    assert 'vproxy_workload_interarrival_us_count{plane="lane"}' in text
+    assert "vproxy_lb_conn_bytes" in text
+    assert "vproxy_lb_conn_duration_ms" in text
+    assert "vproxy_workload_capture_enabled 1" in text
+    print("# surfaces: GET /workload parses back through "
+          "WorkloadModel.from_json; interarrival/conn/knob metric "
+          "families present")
+
+    # ---- events range joined on the capture window's clock --------
+    FlightRecorder.get().record("wlverify", "inside-window")
+    lines = Command.execute(
+        app, f"list event-log since {t_open} until {time.monotonic_ns()}")
+    assert any("wlverify" in line for line in lines), lines[-3:]
+    outside = Command.execute(
+        app, f"list event-log since {t_open} until {t_close}")
+    assert not any("wlverify" in line for line in outside)
+    from vproxy_tpu.net.eventloop import SelectorEventLoop
+    from vproxy_tpu.utils.metrics import launch_inspection_http
+    iloop = SelectorEventLoop("wl-insp")
+    iloop.loop_thread()
+    time.sleep(0.05)
+    insp = launch_inspection_http(iloop, "127.0.0.1", 0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{insp.port}/events?since={t_open}"
+                f"&until={t_close}", timeout=5) as r:
+            evs = json.loads(r.read())
+    finally:
+        insp.close()
+        iloop.close()
+    assert evs and all(
+        t_open <= e["mono_ns"] <= t_close for e in evs), evs[:2]
+    print(f"# events: since/until range joins on monotonic ns "
+          f"({len(evs)} events inside the capture window)")
+
+    # ---- record -> replay -> fidelity loop ------------------------
+    sketch.reset()
+    workload.reset()
+    w = replay.ReplayWorld(alias="wl-drive-src")
+    try:
+        workload.capture_start()
+        mix = replay.drive_zipf_mix(w.lb.bind_port, seed=21, n=120,
+                                    clients=6, pace_s=0.01)
+        workload.capture_stop()
+        src = WorkloadModel.fit(seed=21)
+    finally:
+        w.close()
+    assert mix["fail"] == 0, mix
+    sched = replay.build_schedule(src, 21, max_arrivals=100)
+    h_local = replay.schedule_hash(sched)
+    assert h_local == replay.schedule_hash(
+        replay.build_schedule(src, 21, max_arrivals=100))
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        f.write(src.to_json())
+        mpath = f.name
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    sub = subprocess.run(
+        [sys.executable, os.path.join("tools", "replay.py"),
+         "--model", mpath, "--seed", "21", "--max-arrivals", "100",
+         "--hash-only"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    os.unlink(mpath)
+    assert sub.returncode == 0, sub.stderr[-800:]
+    assert sub.stdout.strip() == h_local, (sub.stdout, h_local)
+    rep = replay.run_replay(src, seed=21, speed=1.0, max_arrivals=100,
+                            fidelity_gate=True, rate_band=(0.8, 1.25))
+    assert rep["results"]["fail"] == 0
+    assert rep["schedule_hash"] == h_local
+    fid = rep["fidelity"]
+    assert fid["pass"], fid
+    assert rep["pass"], rep["slo"]
+    print(f"# replay: schedule {h_local[:16]}… identical in-process + "
+          f"subprocess; fidelity top-K {fid['topk_hits']}/"
+          f"{len(fid['topk_want'])} rate ratio "
+          f"{fid['gates']['rate_ratio_lo']['value']} "
+          f"(late_s={rep['late_s']})")
+    row = replay.capacity_row(src, node_capacity_rps=5000.0,
+                              users=10_000_000, peak_factor=2.0)
+    assert row["nodes_needed"] > 0
+    print(f"# capacity: {row['nodes_needed']} nodes for "
+          f"{row['users'] / 1e6:.0f}M users at 2x peak "
+          f"({row['per_user_rps']:.2f} rps/user, "
+          f"{row['node_capacity_rps']:.0f} rps/node)")
+
+    # ---- knob-off zero-cost ---------------------------------------
+    workload.configure(on=False)
+    lh = lb.lanes.handle
+    c_before = vtl.lanes_capture_stat(lh, 0)[0]
+    py_before = workload._hist("accept").state()[0]
+    for _ in range(10):
+        assert get_id(lb.bind_port) == "A"
+        assert get_id(lb1.bind_port) == "A"
+    time.sleep(0.4)
+    assert vtl.lanes_capture_stat(lh, 0)[0] == c_before, \
+        "C lane capture moved while off"
+    assert workload._hist("accept").state()[0] == py_before
+    st = workload.capture_status()
+    assert st["enabled"] is False
+    workload.configure(on=True)
+    assert get_id(lb.bind_port) == "A"
+    assert wait_for(lambda: vtl.lanes_capture_stat(lh, 0)[0] > c_before)
+    print("# knob-off: 20 sessions with ZERO capture work (C lane "
+          "counter frozen, python histogram frozen); re-enable resumes")
+
+    ctl.stop()
+    app.close()
+    print("# VERIFY WORKLOAD: ALL CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1897,6 +1897,43 @@ def _run_analytics_stage(timeout):
     return {k: rep[k] for k in keys if k in rep}
 
 
+def _run_replay_stage(timeout):
+    """bench_host.py --replay in a CPU-env subprocess: the workload
+    capture -> replay -> fidelity loop (docs/replay.md). The FULL
+    report — source mix, schedule hashes, fidelity ratios, the
+    capture-off overhead pairs, the capacity-planning row — is the
+    committed BENCH replay artifact; the orchestrator folds the
+    headline gates in so every future round carries them."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_replay.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(
+        f"# === stage replay (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--replay"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "replay", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage replay: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    keys = ("replay_seed", "replay_schedule_hash",
+            "replay_determinism_pass", "replay_fidelity",
+            "replay_fidelity_pass", "replay_1x",
+            "replay_overhead_off_vs_on", "replay_overhead_pass",
+            "replay_overhead_off_vs_absent", "replay_offcost_pass",
+            "replay_capacity", "replay_error")
+    return {k: rep[k] for k in keys if k in rep}
+
+
 def _run_static_analysis_stage():
     """tools/vlint over the tree, in-process (parse-only + one clean
     metrics-registry subprocess — seconds, not minutes): the finding
@@ -2140,6 +2177,10 @@ def orchestrate():
     result.update(_run_analytics_stage(
         float(os.environ.get("BENCH_ANALYTICS_TIMEOUT", "300"))))
     publish(result)
+    # workload replay: capture->replay fidelity + capacity row
+    result.update(_run_replay_stage(
+        float(os.environ.get("BENCH_REPLAY_TIMEOUT", "300"))))
+    publish(result)
     # static analysis: vlint finding counts by pass (invariant drift)
     result.update(_run_static_analysis_stage())
     publish(result)
@@ -2175,6 +2216,10 @@ if __name__ == "__main__":
     elif "--analytics" in sys.argv:  # manual: just the analytics stage
         print(json.dumps(_run_analytics_stage(
             float(os.environ.get("BENCH_ANALYTICS_TIMEOUT", "300")))))
+        sys.exit(0)
+    elif "--replay" in sys.argv:  # manual: just the replay stage
+        print(json.dumps(_run_replay_stage(
+            float(os.environ.get("BENCH_REPLAY_TIMEOUT", "300")))))
         sys.exit(0)
     elif "--static-analysis" in sys.argv:  # manual: just the vlint row
         print(json.dumps(_run_static_analysis_stage()))

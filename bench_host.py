@@ -919,6 +919,221 @@ def run_analytics():
     return 0 if ok else 1
 
 
+def run_replay():
+    """`--replay`: the workload capture -> replay -> fidelity loop
+    (ISSUE 16, docs/replay.md).
+
+    1. **source capture** — a seeded-Zipf client mix (distinct
+       loopback source addresses, ground-truth heavy hitters known in
+       advance) through a real TcpLB inside a capture window; export
+       the WorkloadModel.
+    2. **determinism** — the same (model, seed) must produce the same
+       schedule hash in THIS process and in a fresh interpreter
+       (tools/replay.py --hash-only).
+    3. **fidelity at 1x** — replay the model against a fresh world
+       with re-capture: >= 4/5 top-K client identity and offered-rate
+       ratio within [0.9, 1.1], zero hard failures.
+    4. **capture-off overhead** — paired order-alternating A/B on the
+       lane short-conn path, VPROXY_TPU_WORKLOAD off vs on, median
+       ratio of 7 gate <= 1.05 (the analytics-stage discipline), with
+       the off-vs-absent noise-floor pair riding along.
+    5. **capacity row** — the model's per-client rate scaled to a 10M
+       user diurnal peak over the measured per-node capacity.
+
+    The artifact is the committed BENCH replay round."""
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import replay as RP
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                   ServerGroup)
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.utils import sketch as SK
+    from vproxy_tpu.utils import workload as WL
+    from vproxy_tpu.utils.workload import WorkloadModel
+
+    seed = _env_int("HOSTBENCH_SEED", 16)
+    conns = _env_int("HOSTBENCH_CONNS", 32)
+    secs = float(os.environ.get("HOSTBENCH_SECS", "4"))
+    lanes_n = _env_int("HOSTBENCH_LANES", 4)
+    build_tool()
+    result = {"replay_seed": seed, "replay_conns": conns,
+              "replay_secs": secs}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(out_path + ".tmp", out_path)
+
+    procs = []
+    lb = None
+    elg = None
+    groups = []
+    try:
+        # ---- 1. source capture: seeded-Zipf mix, real LB ------------
+        SK.reset()
+        WL.reset()
+        world = RP.ReplayWorld(alias="bench-replay-src")
+        try:
+            WL.capture_start()
+            mix = RP.drive_zipf_mix(world.lb.bind_port, seed=seed,
+                                    n=240, clients=6, pace_s=0.01)
+            WL.capture_stop()
+            model = WorkloadModel.fit(seed=seed)
+        finally:
+            world.close()
+        result["replay_mix"] = {k: mix[k] for k in ("ok", "fail",
+                                                    "shed")}
+        result["replay_true_top5"] = mix["true_top"][:5]
+        result["replay_source_rate_hz"] = model.plane_rate("accept")
+        flush()
+
+        # ---- 2. same-seed schedule identity across processes --------
+        h_local = RP.schedule_hash(
+            RP.build_schedule(model, seed, max_arrivals=200))
+        h_again = RP.schedule_hash(
+            RP.build_schedule(model, seed, max_arrivals=200))
+        fd, mpath = tempfile.mkstemp(suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            f.write(model.to_json())
+        try:
+            from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+            sub = subprocess.run(
+                [sys.executable, os.path.join(here, "tools",
+                                              "replay.py"),
+                 "--model", mpath, "--seed", str(seed),
+                 "--max-arrivals", "200", "--hash-only"],
+                capture_output=True, text=True, timeout=180,
+                env=cpu_subprocess_env())
+            h_sub = sub.stdout.strip()
+        finally:
+            os.unlink(mpath)
+        result["replay_schedule_hash"] = h_local
+        result["replay_schedule_hash_subprocess"] = h_sub
+        result["replay_determinism_pass"] = bool(
+            sub.returncode == 0 and h_local == h_again
+            and h_sub == h_local)
+        flush()
+
+        # ---- 3. replay at 1x with the fidelity gate -----------------
+        rep = RP.run_replay(model, seed=seed, speed=1.0,
+                            max_arrivals=200, fidelity_gate=True,
+                            rate_band=(0.9, 1.1))
+        result["replay_1x"] = {
+            "arrivals": rep["arrivals"], "span_s": rep["span_s"],
+            "late_s": rep["late_s"], "results": rep["results"],
+            "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"],
+            "slo": rep["slo"],
+            "schedule_hash": rep["schedule_hash"],
+        }
+        result["replay_fidelity"] = rep["fidelity"]
+        result["replay_fidelity_pass"] = bool(
+            rep["fidelity"]["pass"] and rep["results"]["fail"] == 0)
+        flush()
+
+        # ---- 4. capture-off overhead (paired A/B, lanes path) -------
+        p, bport = start_server()
+        procs.append(p)
+        elg = EventLoopGroup("w", 4)
+        hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1,
+                               down=2)
+        g = ServerGroup("g", elg, hc, "wrr")
+        groups.append(g)
+        g.add("b0", "127.0.0.1", bport, weight=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not any(s.healthy for s in g.servers):
+            time.sleep(0.05)
+        if not any(s.healthy for s in g.servers):
+            result["replay_error"] = "backend never became healthy"
+            flush()
+            raise RuntimeError(result["replay_error"])
+        ups = Upstream("u")
+        ups.add(g)
+        lb = TcpLB("lb-wl", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=lanes_n)
+        lb.start()
+        run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+        rep_secs = max(2.0, secs / 2)
+
+        def _paired_ratios(knob_a, knob_b, reps=7):
+            # ratio = side_a rps / side_b rps per rep (a=off, b=on:
+            # >1 means the knob costs throughput), order alternating
+            ratios, raw = [], []
+            for r in range(reps):
+                sides = [("a", knob_a), ("b", knob_b)]
+                if r % 2:
+                    sides.reverse()
+                rr = {}
+                for name, knob in sides:
+                    WL.configure(on=knob)
+                    time.sleep(0.5)  # settle: drain the accept burst
+                    rr[name] = run_client(lb.bind_port, conns,
+                                          rep_secs, 1,
+                                          short=True)["rps"]
+                raw.append(rr)
+                ratios.append(rr["a"] / max(1.0, rr["b"]))
+            ratios.sort()
+            return ratios[len(ratios) // 2], raw
+
+        off_vs_absent, raw0 = _paired_ratios(False, False, reps=5)
+        off_vs_on, raw1 = _paired_ratios(False, True)
+        WL.configure(on=True)
+        result["replay_overhead_off_vs_absent"] = round(
+            off_vs_absent, 3)
+        result["replay_overhead_off_vs_on"] = round(off_vs_on, 3)
+        result["replay_overhead_pairs"] = {"off_vs_absent": raw0,
+                                           "off_vs_on": raw1}
+        # the ISSUE gate: capture ON costs <= 5% of lane short-conn
+        # throughput (per accept: one atomic exchange + three
+        # per-connection bucket adds at reap)
+        result["replay_overhead_pass"] = bool(off_vs_on <= 1.05)
+        result["replay_offcost_pass"] = bool(
+            0.8 <= off_vs_absent <= 1.25)
+        flush()
+
+        # ---- 5. capacity-planning row -------------------------------
+        node_rps = max(rr["b"] for rr in raw1)
+        result["replay_capacity"] = RP.capacity_row(
+            model, node_capacity_rps=node_rps)
+        flush()
+    finally:
+        if lb is not None:
+            try:
+                lb.stop()
+            except Exception:
+                pass
+        for g_ in groups:
+            try:
+                g_.close()
+            except Exception:
+                pass
+        if elg is not None:
+            try:
+                elg.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(json.dumps(result))
+    flush()
+    ok = (result.get("replay_determinism_pass", False)
+          and result.get("replay_fidelity_pass", False)
+          and result.get("replay_overhead_pass", False)
+          and result.get("replay_offcost_pass", False))
+    return 0 if ok else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
@@ -934,6 +1149,8 @@ def main():
         return run_trace()
     if "--analytics" in sys.argv[1:]:
         return run_analytics()
+    if "--replay" in sys.argv[1:]:
+        return run_replay()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

@@ -54,6 +54,9 @@ REQUIRED_SYMBOLS = (
     # flow-cache hit drain, and the py==C hash parity surface
     "vtl_hh_rec_size", "vtl_hh_set_enabled", "vtl_hh_hash",
     "vtl_hh_counters", "vtl_hh_drain", "vtl_hh_flow_drain",
+    # workload capture (r16): lane-plane inter-arrival + per-connection
+    # bytes/duration histograms and the capture knob
+    "vtl_lanes_capture_stat", "vtl_workload_set_enabled",
 )
 
 

@@ -229,8 +229,14 @@ def test_adaptive_shed_is_rst_and_leaves_no_time_wait(stack):
     assert c1.recv(1) == b"A"  # session 1 admitted (spliced)
     resets = 0
     for _ in range(12):
-        c = socket.create_connection(("127.0.0.1", lb.bind_port),
-                                     timeout=5)
+        try:
+            c = socket.create_connection(("127.0.0.1", lb.bind_port),
+                                         timeout=5)
+        except ConnectionResetError:
+            # the shed RST can land while the client is still inside
+            # connect() on a loaded box — same designed shed
+            resets += 1
+            continue
         c.settimeout(5)
         try:
             d = c.recv(8)

@@ -43,6 +43,25 @@ def test_storm_smoke_flash_crowd():
 
 
 @pytest.mark.storm
+def test_storm_smoke_replay_flash_crowd():
+    """Scaled-down record-replay loop: a recorded crowd replays at 2x
+    through a fresh world with zero hard failures, and the schedule
+    hash is the same across both in-scenario builds."""
+    import storm
+
+    out = storm.scenario_replay_flash_crowd(scale=0.3, seed=5)
+    assert out["recorded"]["fail"] == 0
+    assert out["replay"]["speed"] == 2.0
+    assert out["slo"]["hard_failures"]["pass"], out
+    assert out["slo"]["schedule_deterministic"]["pass"], out
+    assert len(out["schedule_hash"]) == 64
+    # every replayed session is accounted for: served or shed
+    assert out["replay"]["ok"] + out["replay"]["shed"] + \
+        out["replay"]["fail"] > 0
+    assert out["pass"], out["slo"]
+
+
+@pytest.mark.storm
 def test_restarted_lowest_id_leader_catches_up_from_fleet():
     """The rolling-upgrade edge: node 0 (leader) dies and restarts
     EMPTY while the fleet is generations ahead. It must pull the

@@ -144,18 +144,25 @@ class EchoBackend:
             pass
 
 
-def one_session(port: int, payload: bytes, timeout: float = 5.0) -> str:
+def one_session(port: int, payload: bytes, timeout: float = 5.0,
+                src_ip: str = None) -> str:
     """One byte-verified echo session; returns the backend id or raises
     OSError. Exceptions from the PRE-DATA window (refused connect, RST
     or clean close before the first byte arrived) carry `.shed = True`:
     that is the overload guard refusing fast — the designed degrade —
     and SLO gates score it apart from a session that broke after it
     was accepted for service (a reset mid-echo is a REAL failure, and
-    must never hide inside the shed column)."""
+    must never hide inside the shed column). `src_ip` binds the client
+    side to a specific loopback address (any 127/8 works unbound on
+    Linux) — the replay engine (tools/replay.py) uses it to give every
+    synthesized client a distinct identity the analytics/workload
+    planes can re-capture."""
     _PRE = (ConnectionRefusedError, ConnectionResetError,
             ConnectionAbortedError)
     try:
-        c = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+        c = socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout,
+            source_address=(src_ip, 0) if src_ip else None)
     except _PRE as e:
         e.shed = True
         raise

@@ -842,8 +842,76 @@ def scenario_rolling_upgrade(scale: float = 1.0, seed: int = 0,
 
 # ---------------------------------------------------------------- driver
 
+def scenario_replay_flash_crowd(scale: float = 1.0, seed: int = 0,
+                                log=lambda *_: None) -> dict:
+    """Record-replay under storm rules (docs/replay.md): record a
+    flash-crowd client mix through a real LB (workload capture window
+    + analytics sketch, distinct loopback client addresses), then
+    replay the captured model at 2x SPEED against a FRESH world via
+    tools/replay.py and hold the replay to the legit-traffic SLO —
+    zero hard failures (shed is the designed degrade, scored apart),
+    a served-rate floor, and the p99 bound. The schedule is the
+    seeded-determinism contract: two builds of the same (model, seed)
+    must hash identically and the hash rides the artifact, so a
+    failed gate replays exactly."""
+    import replay as RP
+    from vproxy_tpu.utils import sketch, workload
+    from vproxy_tpu.utils.workload import WorkloadModel
+    rseed = seed or 1
+    n = max(60, int(240 * scale))
+    served_floor, p99_limit_ms = 0.80, 500.0
+    log(f"replay_flash_crowd: recording a {n}-session crowd")
+    sketch.reset()
+    workload.reset()
+    w = _LBWorld("storm-replay-src", n_backends=2, workers=1,
+                 max_sessions=4096)
+    try:
+        workload.capture_start()
+        mix = RP.drive_zipf_mix(w.lb.bind_port, seed=rseed, n=n,
+                                clients=10, alpha=1.3, keys=14,
+                                pace_s=0.004)
+        workload.capture_stop()
+        model = WorkloadModel.fit(seed=rseed)
+    finally:
+        w.close()
+    # same (model, seed) -> byte-identical schedule, twice over
+    h_a = RP.schedule_hash(RP.build_schedule(model, rseed, speed=2.0,
+                                             max_arrivals=n))
+    h_b = RP.schedule_hash(RP.build_schedule(model, rseed, speed=2.0,
+                                             max_arrivals=n))
+    log("replay_flash_crowd: replaying at 2x against a fresh world")
+    rep = RP.run_replay(model, seed=rseed, speed=2.0, max_arrivals=n,
+                        n_backends=2, workers=1, max_sessions=4096,
+                        served_floor=served_floor, p99_ms=p99_limit_ms)
+    total = sum(rep["results"][k] for k in ("ok", "fail", "shed"))
+    slo = {
+        "recorded_mix_clean": _gate(mix["fail"], 0, "=="),
+        "hard_failures": _gate(rep["results"]["fail"], 0, "=="),
+        "served_rate": _gate(rep["results"]["ok"] / max(1, total),
+                             served_floor, ">="),
+        "p99_ms": _gate(rep["p99_ms"], p99_limit_ms, "<="),
+        "schedule_deterministic": _gate(
+            int(h_a == h_b == rep["schedule_hash"]), 1, "=="),
+    }
+    return {
+        "name": "replay_flash_crowd",
+        "recorded": {"sessions": n, "ok": mix["ok"],
+                     "shed": mix["shed"], "fail": mix["fail"],
+                     "true_top3": mix["true_top"][:3]},
+        "model_rate_hz": model.plane_rate("accept"),
+        "schedule_hash": h_a,
+        "replay": {"speed": rep["speed"], "span_s": rep["span_s"],
+                   "late_s": rep["late_s"], "ok": rep["results"]["ok"],
+                   "shed": rep["results"]["shed"],
+                   "fail": rep["results"]["fail"],
+                   "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"]},
+        "slo": slo, "pass": _passed(slo),
+    }
+
+
 SCENARIOS = {
     "flash_crowd": scenario_flash_crowd,
+    "replay_flash_crowd": scenario_replay_flash_crowd,
     "slowloris": scenario_slowloris,
     "dns_storm": scenario_dns_storm,
     "elephant_mice": scenario_elephant_mice,

@@ -45,10 +45,10 @@ from typing import Optional
 
 from ..net import vtl
 from ..rules.ir import Proto
-from ..utils import events, failpoint, sketch, trace
+from ..utils import events, failpoint, sketch, trace, workload
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
-from ..utils.metrics import accept_stage_merge
+from ..utils.metrics import accept_stage_merge, conn_merge
 from .servergroup import Connector
 
 _log = Logger("accept-lanes")
@@ -132,6 +132,11 @@ class AcceptLanes:
         self._stage_last = [(0, 0.0) for _ in vtl.LANE_STAGES]
         self._stage_bkt_last = [[0] * vtl.LANE_STAGE_BUCKETS
                                 for _ in vtl.LANE_STAGES]
+        # cumulative C workload-capture snapshot (same fold, r16):
+        # lane-plane inter-arrival + per-connection bytes/duration
+        self._cap_last = [(0, 0.0) for _ in vtl.LANE_CAPTURES]
+        self._cap_bkt_last = [[0] * vtl.LANE_STAGE_BUCKETS
+                              for _ in vtl.LANE_CAPTURES]
 
     # ------------------------------------------------------------ lifecycle
 
@@ -148,6 +153,9 @@ class AcceptLanes:
         # same idiom for the analytics knob (the lane HH shards gate
         # their per-accept work on one C atomic)
         sketch.push_native_knob()
+        # ...and the workload-capture knob (lane inter-arrival +
+        # per-connection histograms gate on one C atomic too)
+        workload.push_native_knob()
         self.handle = vtl.lanes_new(
             lb.bind_ip, lb.bind_port, 512, self.n, lb.in_buffer_size,
             self.uring, lb.timeout_ms, lb.connect_timeout_ms)
@@ -546,6 +554,7 @@ class AcceptLanes:
                     pass
             if idx == 0:
                 self._merge_stage_hists(handle)
+                self._merge_capture_hists(handle)
             if idx == 0:
                 # retry-budget denominator: lane-SERVED accepts never
                 # pass through _on_accept, but their connect-fail punts
@@ -611,6 +620,35 @@ class AcceptLanes:
                                count - lc)
             self._stage_last[si] = (count, float(sum_us))
             self._stage_bkt_last[si] = bkt
+
+    def _merge_capture_hists(self, handle) -> None:
+        """Fold the C workload-capture deltas into the python-side
+        series (utils/workload satellite): lane-plane inter-arrival
+        into vproxy_workload_interarrival_us{plane=lane}, per-connection
+        bytes/duration into the vproxy_lb_conn_* histograms (process
+        aggregate + this LB). Lane 0's poll tick only — the same
+        delta-fold discipline as _merge_stage_hists."""
+        if not hasattr(vtl.LIB, "vtl_lanes_capture_stat"):
+            return
+        for ci, cap in enumerate(vtl.LANE_CAPTURES):
+            try:
+                count, total, bkt = vtl.lanes_capture_stat(handle, ci)
+            except OSError:
+                return
+            lc, ls = self._cap_last[ci]
+            if count <= lc:
+                continue
+            deltas = [b - p for b, p in zip(bkt, self._cap_bkt_last[ci])]
+            if cap == "interarrival_us":
+                workload.arrival_merge("lane", deltas, float(total - ls),
+                                       count - lc)
+            else:
+                conn_merge(self.lb.alias,
+                           "bytes" if cap == "conn_bytes"
+                           else "duration_ms",
+                           deltas, float(total - ls), count - lc)
+            self._cap_last[ci] = (count, float(total))
+            self._cap_bkt_last[ci] = bkt
 
     def _dispatch(self, punt) -> None:
         fd, kind, err, cip, cport, bip, bport, tid = punt

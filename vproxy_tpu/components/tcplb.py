@@ -26,10 +26,10 @@ from ..net.connection import Connection, Handler, ServerSock
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
-from ..utils import events, failpoint, sketch, trace
+from ..utils import events, failpoint, sketch, trace, workload
 from ..utils.ip import parse_ip
 from ..utils.log import Logger
-from ..utils.metrics import accept_stage_observe
+from ..utils.metrics import accept_stage_observe, conn_observe
 from .elgroup import EventLoopGroup
 from .l7 import L7Engine
 from .lanes import LANES, AcceptLanes
@@ -258,6 +258,12 @@ class _SpliceBack(Handler):
         svr.bytes_out += b2a
         svr.conn_count -= 1
         lb._sessions_delta(-1)
+        # workload capture: the python splice path's per-connection
+        # size/duration (lane-served sessions fold in from C deltas)
+        if workload.ON:
+            t0 = self.t_acc if self.t_acc is not None else self.t_hand
+            dur_ms = (time.monotonic() - t0) * 1e3 if t0 else 0.0
+            conn_observe(lb.alias, a2b + b2a, dur_ms)
         if self.tid:
             now = time.monotonic()
             _tspan(self.tid, "splice", self.t_hand or now, now,
@@ -586,9 +592,11 @@ class TcpLB:
         """A pre-handover phase blew the handshake deadline: RST the
         client (no TIME_WAIT for flood sheds) and count it."""
         vtl.set_linger0(conn.fd)
-        conn.close(errno.ETIMEDOUT)
+        # count BEFORE close: the RST is the client-visible edge, so
+        # the shed must already be on the counters when it lands
         self._halfopen_count(f"{conn.remote[0]}:{conn.remote[1]} shed: "
                              "handshake deadline")
+        conn.close(errno.ETIMEDOUT)
 
     # ------------------------------------------------- warm backend pool
 
@@ -830,9 +838,9 @@ class TcpLB:
         if self.draining:
             # listener close raced an in-flight accept: shed it; the
             # drain contract only protects established sessions
-            vtl.close(cfd)
             events.record("drain_shed", f"{ip}:{port} shed: draining",
                           lb=self.alias)
+            vtl.close(cfd)
             return
         eff = self.effective_max_sessions()
         if self.active_sessions + self.lane_active() >= eff:
@@ -843,20 +851,27 @@ class TcpLB:
             # those punts from doubling the ceiling. Adaptive sheds RST
             # (a crowd big enough to move the ceiling would park one
             # TIME_WAIT per FIN-shed); static keeps the clean close.
+            # account BEFORE closing: the close is the client-visible
+            # edge, so counters/events must already be readable when a
+            # shed client observes it (the probe-then-assert race)
             self._overload_total().incr()
-            if self._overguard is not None:
-                self._shed_total("adaptive").incr()
-                vtl.close_rst(cfd)
-            else:
-                self._shed_total("static").incr()
-                vtl.close(cfd)
+            self._shed_total(
+                "adaptive" if self._overguard is not None else
+                "static").incr()
             events.record(
                 "overload", f"{ip}:{port} shed: {self.active_sessions} "
                 f"sessions at ceiling {eff} (max {self.max_sessions})",
                 lb=self.alias, mode=self.overload_mode)
+            if self._overguard is not None:
+                vtl.close_rst(cfd)
+            else:
+                vtl.close(cfd)
             return
         self.accepted += 1
         self._retry_budget.on_accept()
+        # workload capture (utils/workload): the accept-plane arrival
+        # process — one branch per accept when VPROXY_TPU_WORKLOAD=0
+        workload.note_arrival("accept")
         # analytics (utils/sketch): who is hot right now — one branch
         # per site when VPROXY_TPU_ANALYTICS=0
         if not hh_counted:
@@ -1499,6 +1514,12 @@ class TcpLB:
             svr.bytes_out += b2a
             svr.conn_count -= 1
             lb._sessions_delta(-1)
+            # workload capture: fast-lane sessions land in the same
+            # per-connection histograms as the classic splice path
+            if workload.ON:
+                t0 = t_acc if t_acc is not None else t_reg
+                conn_observe(lb.alias, a2b + b2a,
+                             (time.monotonic() - t0) * 1e3)
             events.record("conn", f"{desc} closed", lb=lb.alias,
                           bytes_in=a2b, bytes_out=b2a, err=err,
                           trace_id=tid)

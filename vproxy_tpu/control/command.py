@@ -104,6 +104,7 @@ PARAM_KEYS = {
     "overload": "overload",
     "seed": "seed",
     "plane": "plane",
+    "since": "since", "until": "until",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -231,6 +232,35 @@ class Command:
                 raise CmdError(f"trace id must be an integer, "
                                f"got {toks[1]!r}")
             return TR.waterfall(tid)
+        if toks and toks[0] == "capture" and len(toks) <= 3:
+            # `capture start|stop|export|status [seed <n>]`: the
+            # workload-capture window (utils/workload). Bare verb like
+            # `drain`/`top`; export prints the versioned model JSON a
+            # replay run consumes (docs/replay.md), with the seed
+            # stamped in so the artifact carries its own determinism.
+            from ..utils import workload as WL
+            if len(toks) == 1:
+                raise CmdError("capture requires a verb: "
+                               "start|stop|export|status")
+            verb, seed = toks[1], None
+            if len(toks) == 3:
+                k, _, v = toks[2].partition("=")
+                if k != "seed" or not v:
+                    raise CmdError(f"unexpected token {toks[2]!r} "
+                                   "(only seed=<int>)")
+                try:
+                    seed = int(v)
+                except ValueError:
+                    raise CmdError(f"seed must be an integer, got {v!r}")
+            try:
+                out = WL.capture(verb, seed=seed)
+            except ValueError as e:
+                raise CmdError(str(e))
+            if verb == "export":
+                return [WL.WorkloadModel(out).to_json()]
+            return [f"capture {out['state']} "
+                    f"(enabled={out['enabled']}, "
+                    f"window={out['window_s']}s)"]
         c = Command.parse(line)
         handler = _HANDLERS.get(c.type)
         if handler is None:
@@ -1306,16 +1336,32 @@ def _h_eventlog(app: Application, c: Command):
     """`list event-log` — the flight-recorder ring (utils/events):
     connection lifecycle, loop stalls, classify failovers, health-check
     edges. list-detail returns the raw event dicts (what /events
-    serves); list returns human-form lines."""
+    serves); list returns human-form lines. `since=`/`until=` bound the
+    window in monotonic ns — the SAME clock trace spans stamp t_ns
+    with, so a capture or incident window joins directly."""
     from ..utils.events import EVENT_PLANES, FlightRecorder
     plane = c.params.get("plane")
     if plane is not None and plane not in EVENT_PLANES:
         raise CmdError(f"unknown event plane {plane!r} "
                        f"(one of {', '.join(EVENT_PLANES)})")
+
+    def _ns(key):
+        v = c.params.get(key)
+        if v is None:
+            return None
+        try:
+            return int(v)
+        except ValueError:
+            raise CmdError(f"{key} must be an integer (monotonic ns), "
+                           f"got {v!r}")
+
+    since, until = _ns("since"), _ns("until")
     if c.action == "list":
-        return FlightRecorder.get().lines(plane=plane)
+        return FlightRecorder.get().lines(plane=plane, since=since,
+                                          until=until)
     if c.action == "list-detail":
-        return FlightRecorder.get().snapshot(plane=plane)
+        return FlightRecorder.get().snapshot(plane=plane, since=since,
+                                             until=until)
     raise CmdError(f"unsupported action {c.action} for event-log")
 
 

@@ -204,6 +204,16 @@ class HttpController:
             r.resp.end(SK.snapshot_with_fleet())
 
         srv.get("/analytics", analytics_ep)
+
+        def workload_ep(r: RoutingContext) -> None:
+            # the workload-capture artifact (utils/workload): the
+            # current window's fitted model — same payload as the
+            # inspection server's /workload, consumed live by
+            # tools/replay.py (docs/replay.md)
+            from ..utils import workload as WL
+            r.resp.end(WL.export_model())
+
+        srv.get("/workload", workload_ep)
         srv.post("/api/v1/command", self._command)
         srv.all("/api/v1/module/*", self._module)
         srv.listen(self.bind_port, self.bind_ip)

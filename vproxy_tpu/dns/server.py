@@ -28,7 +28,7 @@ from ..components.upstream import Upstream
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
 from ..rules.ir import Hint, Proto
-from ..utils import sketch
+from ..utils import sketch, workload
 from ..utils.ip import is_ip_literal, parse_ip
 from ..utils.log import Logger
 from . import packet as P
@@ -222,6 +222,10 @@ class DNSServer:
             self._respond(req, ip, port, [], rcode=1)
             return
         qs = list(req.questions)
+        # workload capture: the dns-plane arrival process (one query =
+        # one arrival, cache hits included — the offered load is what
+        # the capacity model wants, not the miss rate)
+        workload.note_arrival("dns")
         # analytics: which qnames are hot (covers cache hits too — the
         # whole point is seeing the crowd, cached or not)
         if sketch.ON:

@@ -1332,6 +1332,13 @@ int vtl_errno_eagain() { return EAGAIN; }
 static std::atomic<int> g_hh_on(0);
 static std::atomic<uint64_t> g_hh_updates(0), g_hh_overflow(0);
 
+// workload-capture knob (r16): the accept lanes' inter-arrival and
+// per-connection bytes/duration histograms gate on this one relaxed
+// load, exactly like g_hh_on gates the HH shards — knob-off cost on
+// the accept/reap paths is that single load. Python pushes it from
+// utils/workload.configure() (same idiom as sketch.push_native_knob).
+static std::atomic<int> g_wl_on(0);
+
 #pragma pack(push, 1)
 struct FlowKey {          // 26 bytes; must match vtl.py FLOW_REC prefix
   uint32_t sender_ip;     // host-order u32 of the v4 sender addr
@@ -2391,6 +2398,10 @@ void vtl_hh_set_enabled(int on) {
   g_hh_on.store(on ? 1 : 0, std::memory_order_relaxed);
 }
 
+void vtl_workload_set_enabled(int on) {
+  g_wl_on.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
 // the parity surface: python's sketch.fnv64 must agree bit for bit
 unsigned long long vtl_hh_hash(const void* p, int n) {
   return maglev_fnv64((const uint8_t*)p, (size_t)(n > 0 ? n : 0));
@@ -2606,6 +2617,16 @@ struct Lanes {
   unsigned long long stage_count[3] = {};
   unsigned long long stage_sum_us[3] = {};
   unsigned long long stage_bkt[3][28] = {};
+  // workload capture (r16): lane-plane arrival process + per-connection
+  // size/duration, same log2 bucket rule and the same delta-fold drain
+  // as the stage histograms (lane 0's tick merges into the python
+  // histograms). Index contract with vtl.py LANE_CAPTURES:
+  // 0 interarrival_us, 1 conn_bytes, 2 conn_duration_ms. Gated on
+  // g_wl_on so the capture-off A/B gate has a real knob to toggle.
+  unsigned long long cap_count[3] = {};
+  unsigned long long cap_sum[3] = {};
+  unsigned long long cap_bkt[3][28] = {};
+  std::atomic<uint64_t> cap_last_accept_us{0};
   // trace sampling cursor (1-in-N across this Lanes object's threads)
   std::atomic<uint64_t> trace_seq{0};
 };
@@ -2638,6 +2659,29 @@ int vtl_lanes_stage_stat(void* lp, int stage, uint64_t* out) {
   out[1] = __atomic_load_n(&ow->stage_sum_us[stage], __ATOMIC_RELAXED);
   for (int i = 0; i < 28; ++i)
     out[2 + i] = __atomic_load_n(&ow->stage_bkt[stage][i],
+                                 __ATOMIC_RELAXED);
+  return 30;
+}
+
+#define LANE_CAP_INTERARRIVAL 0
+#define LANE_CAP_CONN_BYTES 1
+#define LANE_CAP_CONN_MS 2
+
+static inline void lanes_cap_obs(Lanes* ow, int w, unsigned long long v) {
+  __atomic_fetch_add(&ow->cap_count[w], 1ull, __ATOMIC_RELAXED);
+  __atomic_fetch_add(&ow->cap_sum[w], v, __ATOMIC_RELAXED);
+  __atomic_fetch_add(&ow->cap_bkt[w][lanes_bucket(v)], 1ull,
+                     __ATOMIC_RELAXED);
+}
+
+// out = [count, sum, bucket0..bucket27] for one capture series -> 30
+int vtl_lanes_capture_stat(void* lp, int which, uint64_t* out) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || which < 0 || which > 2) return -EINVAL;
+  out[0] = __atomic_load_n(&ow->cap_count[which], __ATOMIC_RELAXED);
+  out[1] = __atomic_load_n(&ow->cap_sum[which], __ATOMIC_RELAXED);
+  for (int i = 0; i < 28; ++i)
+    out[2 + i] = __atomic_load_n(&ow->cap_bkt[which][i],
                                  __ATOMIC_RELAXED);
   return 30;
 }
@@ -2792,6 +2836,19 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
   uint64_t t_acc = mono_ns();  // stage histograms need it on every path
   ow->accepted.fetch_add(1, std::memory_order_relaxed);
   g_lane_accepted.fetch_add(1, std::memory_order_relaxed);
+  if (g_wl_on.load(std::memory_order_relaxed)) {
+    // lane-plane arrival process: one exchange on a shared cursor, the
+    // delta is the inter-arrival gap across ALL lanes of this Lanes
+    // object (the workload model wants the plane's merged process, not
+    // per-thread ones). A relaxed-exchange race reorders two nearby
+    // accepts — it perturbs one sample, never corrupts the histogram.
+    uint64_t now_us = t_acc / 1000;
+    uint64_t prev = ow->cap_last_accept_us.exchange(
+        now_us, std::memory_order_relaxed);
+    if (prev)
+      lanes_cap_obs(ow, LANE_CAP_INTERARRIVAL,
+                    now_us > prev ? now_us - prev : 0);
+  }
   // deterministic 1-in-N sampling: one relaxed load when the knob is
   // off; a sampled accept allocates an even trace id (python: odd)
   uint64_t samp = g_trace_sample.load(std::memory_order_relaxed);
@@ -2983,6 +3040,18 @@ static void lane_reap(Lane* ln) {
       g_lane_served.fetch_add(1, std::memory_order_relaxed);
       ow->bytes.fetch_add(p->bytes_a2b + p->bytes_b2a,
                           std::memory_order_relaxed);
+    }
+    if (!p->connect_failed && g_wl_on.load(std::memory_order_relaxed)) {
+      // per-connection size/duration for the workload model: killed
+      // sessions count too (they carried bytes), connect failures
+      // never reached the serving distribution
+      lanes_cap_obs(ow, LANE_CAP_CONN_BYTES, p->bytes_a2b + p->bytes_b2a);
+      if (mit != ln->meta.end() && mit->second.t_acc_ns) {
+        uint64_t now = mono_ns();
+        uint64_t acc = mit->second.t_acc_ns;
+        lanes_cap_obs(ow, LANE_CAP_CONN_MS,
+                      now > acc ? (now - acc) / 1000000ull : 0);
+      }
     }
     if (tid && !p->connect_failed) {
       // whole-lifetime close-out: the splice span covers connected ->

@@ -179,6 +179,11 @@ def _load() -> ctypes.CDLL:
         lib.vtl_hh_flow_drain.argtypes = [p, ctypes.c_void_p, c]
     except AttributeError:
         pass
+    try:  # workload-capture histograms + knob (absent from a pre-r16 .so)
+        lib.vtl_workload_set_enabled.argtypes = [c]
+        lib.vtl_lanes_capture_stat.argtypes = [p, c, ctypes.POINTER(u64)]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -1161,6 +1166,33 @@ def lanes_stage_stat(handle: int, stage: int) -> tuple:
     check(fn(handle, stage, out))
     return (int(out[0]), int(out[1]),
             [int(out[2 + i]) for i in range(LANE_STAGE_BUCKETS)])
+
+
+# capture-index contract with the C LANE_CAP_* defines: the workload
+# histogram each lane-plane capture series folds into
+LANE_CAPTURES = ("interarrival_us", "conn_bytes", "conn_duration_ms")
+
+
+def lanes_capture_stat(handle: int, which: int) -> tuple:
+    """(count, sum, [28 log2 bucket counts]) for one LANE_CAPTURES
+    entry of one Lanes object — cumulative, like lanes_stage_stat;
+    lane 0's tick merges the DELTAS into the workload/conn histograms."""
+    fn = getattr(LIB, "vtl_lanes_capture_stat", None)
+    if fn is None:
+        return (0, 0, [0] * LANE_STAGE_BUCKETS)
+    out = (ctypes.c_uint64 * (2 + LANE_STAGE_BUCKETS))()
+    check(fn(handle, which, out))
+    return (int(out[0]), int(out[1]),
+            [int(out[2 + i]) for i in range(LANE_STAGE_BUCKETS)])
+
+
+def workload_set_enabled(on: bool) -> None:
+    """Push the workload-capture knob into the native plane (no-op on a
+    pre-r16 .so or the python provider — capture still works for the
+    python-path planes, the lane plane just contributes nothing)."""
+    fn = getattr(LIB, "vtl_workload_set_enabled", None)
+    if fn is not None:
+        fn(1 if on else 0)
 
 
 def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:

@@ -51,6 +51,13 @@ _KIND_PLANE = {
 }
 
 
+def _mono_ns(ev: dict) -> int:
+    """An event's monotonic-ns stamp (derived from the float `mono`
+    for events recorded before the field existed)."""
+    ns = ev.get("mono_ns")
+    return int(ns) if ns is not None else int(ev.get("mono", 0.0) * 1e9)
+
+
 def plane_of(ev: dict) -> str:
     """The plane an event belongs to: its explicit plane= field when
     one was recorded, else the kind classification, else "app"."""
@@ -90,7 +97,7 @@ class FlightRecorder:
         trace waterfall join recorder events and spans instead of two
         unjoinable logs."""
         ev = {"seq": 0, "ts": time.time(), "mono": time.monotonic(),
-              "kind": kind, "msg": msg}
+              "mono_ns": time.monotonic_ns(), "kind": kind, "msg": msg}
         if trace_id:
             ev["trace_id"] = trace_id
         if fields:
@@ -102,25 +109,38 @@ class FlightRecorder:
             self._ring.append(ev)
 
     def snapshot(self, last: int = 0, trace: Optional[int] = None,
-                 plane: Optional[str] = None) -> list:
+                 plane: Optional[str] = None,
+                 since: Optional[int] = None,
+                 until: Optional[int] = None) -> list:
         """Events oldest-first; `last` > 0 trims to the newest N;
         `trace` filters to events carrying that trace_id; `plane`
-        filters by plane_of() classification."""
+        filters by plane_of() classification; `since`/`until` are
+        inclusive monotonic-ns bounds on the SAME clock trace spans
+        stamp t_ns with (time.monotonic_ns) — a capture or incident
+        window joins recorder events against traces directly."""
         with self._lock:
             evs = list(self._ring)
         if trace is not None:
             evs = [e for e in evs if e.get("trace_id") == trace]
         if plane is not None:
             evs = [e for e in evs if plane_of(e) == plane]
+        if since is not None:
+            evs = [e for e in evs if _mono_ns(e) >= since]
+        if until is not None:
+            evs = [e for e in evs if _mono_ns(e) <= until]
         return evs[-last:] if last > 0 else evs
 
-    def lines(self, last: int = 0, plane: Optional[str] = None) -> list:
+    def lines(self, last: int = 0, plane: Optional[str] = None,
+              since: Optional[int] = None,
+              until: Optional[int] = None) -> list:
         """Human-form rendering for the command surface."""
         out = []
-        for ev in self.snapshot(last, plane=plane):
+        for ev in self.snapshot(last, plane=plane, since=since,
+                                until=until):
             extras = " ".join(
                 f"{k}={ev[k]}" for k in sorted(ev)
-                if k not in ("seq", "ts", "mono", "kind", "msg"))
+                if k not in ("seq", "ts", "mono", "mono_ns", "kind",
+                             "msg"))
             stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
             out.append(f"[{ev['seq']}] {stamp} {ev['kind']}: {ev['msg']}"
                        + (f" ({extras})" if extras else ""))

@@ -155,6 +155,12 @@ class Histogram(Metric):
     def value(self) -> float:
         return self._count
 
+    def state(self) -> Tuple[int, float, List[int]]:
+        """(count, sum, [bucket counts]) snapshot — the workload-capture
+        delta-window primitive (utils/workload.py)."""
+        with self._lock:
+            return self._count, self._sum, list(self._counts)
+
     def sample_lines(self) -> List[str]:
         with self._lock:
             counts = list(self._counts)
@@ -424,6 +430,22 @@ class GlobalInspection:
         for st in ("acl", "classify", "backend_pick", "handover",
                    "total"):
             self.get_histogram("vproxy_accept_stage_us", stage=st)
+        # workload-capture plane (utils/workload.py): per-plane arrival
+        # inter-arrival histograms + the process-wide per-connection
+        # bytes/duration series — CLOSED vocabularies, eagerly created
+        # so the vlint registry pass stays green with zero new baseline
+        # entries (the per-LB labeled conn series created at TcpLB
+        # construction reuse these family names; the registry check is
+        # name-level)
+        from . import workload as _workload
+        for pl in _workload.PLANES:
+            self.get_histogram("vproxy_workload_interarrival_us",
+                               plane=pl)
+        self.get_histogram("vproxy_lb_conn_bytes")
+        self.get_histogram("vproxy_lb_conn_duration_ms")
+        self.registry.gauge_f(
+            "vproxy_workload_capture_enabled",
+            lambda: 1.0 if _workload.enabled() else 0.0)
         # install/build latency histograms: eagerly created HERE (the
         # reservoir config lives at this single site — _get_named's
         # first-creation-wins rule means the component-side
@@ -664,6 +686,46 @@ def accept_stage_merge(stage: str, bucket_deltas, sum_us: float,
     h.merge(bucket_deltas, sum_us, count)
 
 
+# per-connection size/duration histograms (the workload-capture
+# satellite): one process-wide aggregate pair (lb=None — what the
+# workload model reads) plus a labeled pair per LB. Memoized like the
+# stage histograms; a racy double-create dedups through _get_named.
+_CONN_HISTS: Dict[Optional[str], Tuple[Histogram, Histogram]] = {}
+
+
+def conn_hists(lb: Optional[str] = None) -> Tuple[Histogram, Histogram]:
+    """(bytes, duration_ms) histogram pair for one LB (or the process
+    aggregate when lb is None)."""
+    pair = _CONN_HISTS.get(lb)
+    if pair is None:
+        gi = GlobalInspection.get()
+        labels = {"lb": lb} if lb else {}
+        pair = _CONN_HISTS[lb] = (
+            gi.get_histogram("vproxy_lb_conn_bytes", **labels),
+            gi.get_histogram("vproxy_lb_conn_duration_ms", **labels))
+    return pair
+
+
+def conn_observe(lb: Optional[str], nbytes: float, dur_ms: float) -> None:
+    """One closed python-path session's size/duration, folded into the
+    per-LB series AND the process aggregate the workload model reads."""
+    for target in ((None, lb) if lb else (None,)):
+        hb, hd = conn_hists(target)
+        hb.observe(nbytes)
+        hd.observe(dur_ms)
+
+
+def conn_merge(lb: Optional[str], which: str, bucket_deltas,
+               sum_delta: float, count: int) -> None:
+    """Fold C-side pre-bucketed per-connection counts (accept lanes,
+    vtl_lanes_capture_stat deltas) into the SAME series the python
+    splice path populates — lane-served connections stop being
+    invisible to the conn histograms. which: "bytes" | "duration_ms"."""
+    idx = 0 if which == "bytes" else 1
+    for target in ((None, lb) if lb else (None,)):
+        conn_hists(target)[idx].merge(bucket_deltas, sum_delta, count)
+
+
 def launch_inspection_http(loop, ip: str, port: int):
     """Serve /metrics, /lsof, /jstack, /events, /healthz — the
     reference's `-Dglobal_inspection=host:port` server (Main.java:
@@ -695,8 +757,20 @@ def launch_inspection_http(loop, ip: str, port: int):
         # ?plane=<p>: only events of that plane (utils/events.plane_of
         # — the analytics drill-down filter)
         plane = ctx.req.query.get("plane") or None
-        ctx.resp.end(FlightRecorder.get().snapshot(last, trace=tid or None,
-                                                   plane=plane))
+
+        # ?since=&until=: monotonic-ns bounds, the SAME clock trace
+        # spans stamp t_ns with — a capture window joins against
+        # recorder events without clock arithmetic
+        def _ns(key):
+            try:
+                v = int(ctx.req.query.get(key, "0"))
+            except ValueError:
+                v = 0
+            return v or None
+
+        ctx.resp.end(FlightRecorder.get().snapshot(
+            last, trace=tid or None, plane=plane,
+            since=_ns("since"), until=_ns("until")))
 
     srv.get("/events", events)
 
@@ -708,6 +782,14 @@ def launch_inspection_http(loop, ip: str, port: int):
         ctx.resp.end(SK.snapshot_with_fleet())
 
     srv.get("/analytics", analytics)
+
+    def workload_ep(ctx) -> None:
+        # the capture artifact (utils/workload): the current window's
+        # fitted model — tools/replay.py consumes this live
+        from . import workload as WL
+        ctx.resp.end(WL.export_model())
+
+    srv.get("/workload", workload_ep)
 
     def trace_ep(ctx) -> None:
         # GET /trace -> recent trace summaries; ?id=<trace> -> that
