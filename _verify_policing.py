@@ -1,0 +1,295 @@
+"""Scenario drive: the admission-policing plane through the operator
+surfaces (the verify-skill recipe, round 19 — docs/robustness.md
+"admission policing").
+
+Covers: a grammar-built lanes LB with a `policy` resource added via the
+command grammar, a herd address detected by the analytics sketches and
+then SHED IN C (RST, zero python accepts) with the legacy + policing
+metric families and per-LB attribution all moving, `list[-detail]
+policy` / `GET /policing` / `GET /analytics` serving the live table,
+the `plane=policing` flight-recorder drill-down, DNS qname quarantine
+(REFUSED ahead of the answer cache, innocent names unaffected), a
+fleet-merged peer table arriving over a REAL heartbeat datagram (the
+`police` meta field), the knob-off zero-cost check (C counter frozen),
+and seeded shed-set determinism via the policing.decision.force coin.
+
+Run: env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python _verify_policing.py
+"""
+import json
+import socket
+import time
+import urllib.request
+
+from vproxy_tpu.control.app import Application
+from vproxy_tpu.control.command import CmdError, Command
+from vproxy_tpu.control.http_controller import HttpController
+from vproxy_tpu.net import vtl
+from vproxy_tpu.policing import engine as policing
+from vproxy_tpu.utils import failpoint, lifecycle, sketch
+
+HERD = "127.0.7.7"
+
+
+class IdSrv:
+    def __init__(self, ident):
+        self.ident = ident.encode()
+        self.s = socket.socket()
+        self.s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.s.bind(("127.0.0.1", 0))
+        self.s.listen(64)
+        self.port = self.s.getsockname()[1]
+        import threading
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                c, _ = self.s.accept()
+            except OSError:
+                return
+            try:
+                c.sendall(self.ident)
+                c.close()
+            except OSError:
+                pass
+
+
+def herd_get(port, src=HERD):
+    """One session from the herd address: the backend id, or
+    'refused' when the policing plane RSTs the accept."""
+    try:
+        c = socket.create_connection(("127.0.0.1", port), timeout=5,
+                                     source_address=(src, 0))
+    except OSError:
+        return "refused"
+    c.settimeout(5)
+    try:
+        b = c.recv(16)
+    except OSError:
+        b = b""
+    finally:
+        c.close()
+    return b.decode() if b else "refused"
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def main():
+    assert vtl.police_supported(), "native policing surface unavailable"
+    assert sketch.enabled(), "set VPROXY_TPU_ANALYTICS=1 for the drive"
+    lifecycle.reset()
+    sketch.reset()
+    policing.configure(True)
+    eng = policing.default()
+    eng.set_policies([])
+    eng.reset()
+    app = Application.create(workers=2)
+    ctl = HttpController(app, "127.0.0.1", 0)
+    ctl.start()
+    srv = IdSrv("A")
+    for cmd in (
+            "add upstream u0",
+            "add server-group g0 timeout 500 period 100 up 1 down 1",
+            "add server-group g0 to upstream u0 weight 10",
+            f"add server sA to server-group g0 address "
+            f"127.0.0.1:{srv.port} weight 10"):
+        assert Command.execute(app, cmd) == "OK", cmd
+    g = app.server_groups["g0"]
+    assert wait_for(lambda: any(s.healthy for s in g.servers))
+    assert Command.execute(
+        app, "add tcp-lb lb0 address 127.0.0.1:0 upstream u0 "
+        "protocol tcp lanes 2") == "OK"
+    lb = app.tcp_lbs["lb0"]
+    assert lb.lanes is not None
+
+    # ---- policy resource via the command grammar ------------------
+    assert Command.execute(
+        app, "add policy crowd dim=clients rate=2 burst=4 action=shed"
+    ) == "OK"
+    assert Command.execute(app, "list policy") == ["crowd"]
+    try:
+        Command.execute(app, "add policy crowd dim=clients rate=9 "
+                             "burst=9 action=shed")
+        raise AssertionError("duplicate policy accepted")
+    except CmdError:
+        pass
+
+    # ---- detection precedes enforcement ---------------------------
+    # the herd must SURFACE through the lane HH-shard drain before a
+    # tick can bucket it (the adversarial_crowd discipline)
+    for _ in range(10):
+        assert herd_get(lb.bind_port) == "A"
+    assert wait_for(lambda: any(r["key"] == HERD
+                                for r in sketch.top_table("clients", 0)))
+    policing.tick()
+    assert any(e["key"] == HERD for e in eng.table_snapshot())
+    print(f"# detection: {HERD} surfaced via the C shard drain and is "
+          "bucketed in the decision table")
+
+    # ---- enforcement IN C: RST sheds, zero python accepts ---------
+    served = refused = 0
+    for _ in range(40):
+        if herd_get(lb.bind_port) == "A":
+            served += 1
+        else:
+            refused += 1
+    assert lb.accepted == 0, "python accept path fired"
+    assert refused >= 20, (served, refused)
+    c_checked, c_shed = vtl.police_counters(lb.lanes.handle)[:2]
+    assert c_checked >= refused and c_shed >= refused
+    # the C deltas fold on the lane-0 drain into BOTH the policing
+    # attribution and the legacy families pre-r19 dashboards alert on
+    assert wait_for(lambda: eng.policed_total(
+        lb="lb0", action="shed", dim="clients") >= refused)
+    from vproxy_tpu.utils.metrics import GlobalInspection
+    text = GlobalInspection.get().prometheus_string()
+    assert 'vproxy_lb_policed_total{action="shed",dim="clients"}' in text
+    assert 'reason="policed"' in text
+    print(f"# enforcement: {refused}/40 herd sessions RST-shed in C "
+          f"(served={served}, C checked={c_checked} shed={c_shed}, "
+          "0 python accepts), attribution + legacy families moved")
+
+    # ---- operator surfaces ----------------------------------------
+    det = Command.execute(app, "list-detail policy")
+    assert any("crowd -> dim clients" in line for line in det), det
+    assert any(line.startswith("policing on") for line in det), det
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ctl.bind_port}/policing",
+            timeout=5) as r:
+        doc = json.loads(r.read())
+    assert doc["enabled"] is True
+    assert any(e["key"] == HERD for e in doc["table"]), doc["table"]
+    assert sum(doc["policed_by_node"].values()) >= refused
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{ctl.bind_port}/analytics",
+            timeout=5) as r:
+        adoc = json.loads(r.read())
+    assert "policing" in adoc, list(adoc)
+    print("# surfaces: list[-detail] policy / GET /policing / "
+          "GET /analytics all serve the live table")
+
+    # ---- DNS qname quarantine -------------------------------------
+    assert Command.execute(
+        app, "add dns-server dns0 address 127.0.0.1:0 upstream u0"
+    ) == "OK"
+    assert Command.execute(
+        app, "add policy qhot dim=qnames rate=1 burst=2 action=shed"
+    ) == "OK"
+    d = app.dns_servers["dns0"]
+    from vproxy_tpu.dns import packet as P
+
+    def dns_rcode(name):
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(3)
+        pkt = P.Packet(id=99, rd=True, questions=[P.Question(name, P.A)])
+        s.sendto(pkt.encode(), ("127.0.0.1", d.bind_port))
+        data, _ = s.recvfrom(4096)
+        s.close()
+        return P.parse(data).rcode
+
+    saw_refused = False
+    for _ in range(60):
+        if dns_rcode("flood.example.com.") == 5:  # REFUSED
+            saw_refused = True
+            break
+        time.sleep(0.05)
+    assert saw_refused, "qname flood never quarantined"
+    assert d.quarantines > 0
+    assert dns_rcode("innocent.example.com.") != 5  # isolation
+    print(f"# dns: flood.example.com. quarantined (REFUSED, "
+          f"{d.quarantines} refusals); innocent names still answer")
+
+    # ---- flight-recorder drill-down -------------------------------
+    # C-lane sheds fold COUNTERS only (no per-shed event spam); the
+    # python-plane verdicts — the DNS quarantine above — carry the
+    # policy_shed/quarantine events, and every tick logs its install
+    evs = Command.execute(app, "list-detail event-log plane policing")
+    kinds = {e["kind"] for e in evs}
+    assert {"policy_install", "policy_shed", "quarantine"} <= kinds, \
+        kinds
+    print(f"# events: plane=policing -> {len(evs)} events "
+          f"(install/shed/quarantine kinds present)")
+
+    # ---- fleet: a peer's table over a REAL heartbeat --------------
+    import os
+    peer_sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    peer_sock.bind(("127.0.0.1", 0))
+    peer_port = peer_sock.getsockname()[1]
+    os.environ["VPROXY_TPU_CLUSTER_SELF"] = "0"
+    from vproxy_tpu.cluster import ClusterNode, parse_peers
+    peers = parse_peers(f"127.0.0.1:0,127.0.0.1:{peer_port}")
+    node = ClusterNode(app, 0, peers)
+    app.cluster = node
+    node.membership.start()
+    me = node.membership.peers[0]
+    hb = {"t": "hb", "id": 1, "inc": time.time(), "gen": 0,
+          "stepping": False,
+          "police": {"seq": 3, "t": [["clients", "10.88.0.1",
+                                      1000, 2000, 2]]}}
+
+    def pump_hb():
+        peer_sock.sendto(json.dumps(hb).encode(), ("127.0.0.1", me.port))
+        return any(e["key"] == "10.88.0.1" and e["origin"] == "peer"
+                   for e in eng.table_snapshot())
+
+    assert wait_for(pump_hb, 15), "peer table never merged"
+    st = eng.status()
+    assert st["gossip_merges_total"] >= 1
+    print(f"# fleet: peer entry 10.88.0.1 merged from a protocol-level "
+          f"heartbeat (gossip_merges={st['gossip_merges_total']})")
+
+    # ---- knob-off zero-cost ---------------------------------------
+    policing.configure(False)
+    c_before = vtl.police_counters(lb.lanes.handle)[0]
+    for _ in range(10):
+        assert herd_get(lb.bind_port) == "A"  # all admitted while off
+    time.sleep(0.3)
+    assert vtl.police_counters(lb.lanes.handle)[0] == c_before
+    det = Command.execute(app, "list-detail policy")
+    assert any(line.startswith("policing off") for line in det), det
+    policing.configure(True)
+    print("# knob-off: 10 herd sessions admitted with the C counter "
+          "FROZEN; surface reports off; re-enabled")
+
+    # ---- seeded shed-set determinism ------------------------------
+    os.environ["VPROXY_TPU_FAILPOINT_SEED"] = "1719"
+    seq = [f"10.9.{i % 7}.{i % 11}" for i in range(60)]
+
+    def receipt():
+        e2 = policing.PolicingEngine()
+        failpoint.arm("policing.decision.force", probability=0.3,
+                      seed=1719)
+        try:
+            for k in seq:
+                e2.check("clients", k, lb="drive")
+        finally:
+            failpoint.clear()
+        return e2.shed_receipt()
+
+    r_a, r_b = receipt(), receipt()
+    assert r_a == r_b and len(r_a) == 16
+    print(f"# determinism: same seed + same arrivals -> same shed set "
+          f"(receipt {r_a})")
+
+    # ---- teardown -------------------------------------------------
+    assert Command.execute(app, "remove policy qhot") == "OK"
+    assert Command.execute(app, "remove policy crowd") == "OK"
+    assert Command.execute(app, "list policy") == []
+    node.close()
+    peer_sock.close()
+    ctl.stop()
+    app.close()
+    eng.set_policies([])
+    eng.reset()
+    print("# VERIFY POLICING: ALL CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

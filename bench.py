@@ -1934,6 +1934,48 @@ def _run_replay_stage(timeout):
     return {k: rep[k] for k in keys if k in rep}
 
 
+def _run_policing_stage(timeout):
+    """bench_host.py --policing in a CPU-env subprocess: the admission
+    policing rows (docs/robustness.md "admission policing"). The FULL
+    report — paired lane-overhead pairs with the probe-liveness
+    evidence, plus the whole adversarial_crowd storm verdict — is the
+    committed BENCH policing artifact; the orchestrator folds the
+    headline gates in so every future round carries them."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    result_file = os.path.join(here, ".bench_result_policing.json")
+    if os.path.exists(result_file):
+        os.unlink(result_file)
+    from vproxy_tpu.utils.jaxenv import cpu_subprocess_env
+    env = cpu_subprocess_env()
+    env["HOSTBENCH_RESULT_FILE"] = result_file
+    sys.stderr.write(
+        f"# === stage policing (timeout {timeout:.0f}s) ===\n")
+    p = _run_child([sys.executable, os.path.join(here, "bench_host.py"),
+                    "--policing"], env, here)
+    sys.stderr.flush()
+    _wait_stage(p, "policing", timeout)
+    if not os.path.exists(result_file):
+        sys.stderr.write("# stage policing: no result\n")
+        return {}
+    try:
+        with open(result_file) as f:
+            rep = json.load(f)
+    except ValueError:
+        return {}
+    keys = ("policing_seed", "policing_lane_engine",
+            "policing_overhead_off_vs_on", "policing_overhead_pass",
+            "policing_overhead_off_vs_absent", "policing_offcost_pass",
+            "policing_probe_checked", "policing_probe_active",
+            "policing_storm_pass", "policing_error")
+    out = {k: rep[k] for k in keys if k in rep}
+    # the headline SLO row only — the full scenario lives in the
+    # stage artifact (BENCH_r19), not every future round
+    slo = rep.get("policing_storm", {}).get("slo")
+    if slo is not None:
+        out["policing_storm_slo"] = slo
+    return out
+
+
 def _run_static_analysis_stage():
     """tools/vlint over the tree, in-process (parse-only + one clean
     metrics-registry subprocess — seconds, not minutes): the finding
@@ -2181,6 +2223,10 @@ def orchestrate():
     result.update(_run_replay_stage(
         float(os.environ.get("BENCH_REPLAY_TIMEOUT", "300"))))
     publish(result)
+    # admission policing: lane-overhead gate + adversarial_crowd verdict
+    result.update(_run_policing_stage(
+        float(os.environ.get("BENCH_POLICING_TIMEOUT", "300"))))
+    publish(result)
     # static analysis: vlint finding counts by pass (invariant drift)
     result.update(_run_static_analysis_stage())
     publish(result)
@@ -2220,6 +2266,10 @@ if __name__ == "__main__":
     elif "--replay" in sys.argv:  # manual: just the replay stage
         print(json.dumps(_run_replay_stage(
             float(os.environ.get("BENCH_REPLAY_TIMEOUT", "300")))))
+        sys.exit(0)
+    elif "--policing" in sys.argv:  # manual: just the policing stage
+        print(json.dumps(_run_policing_stage(
+            float(os.environ.get("BENCH_POLICING_TIMEOUT", "300")))))
         sys.exit(0)
     elif "--static-analysis" in sys.argv:  # manual: just the vlint row
         print(json.dumps(_run_static_analysis_stage()))

@@ -1134,6 +1134,203 @@ def run_replay():
     return 0 if ok else 1
 
 
+def run_policing():
+    """`--policing`: the admission-policing rows (ISSUE 19,
+    docs/robustness.md "admission policing").
+
+    1. **overhead gate** — interleaved PAIRED short-conn A/B on the
+       lanes path: policing OFF vs ON with a live decision table
+       that CONTAINS the bench client (huge quota, so every accept
+       pays the full probe + bucket debit and none sheds — the
+       honest worst case for the hot path), median ratio over 7
+       alternating-order pairs, gate rps_off/rps_on <= 1.05; the
+       off-vs-absent pair rides along as the noise floor. The probe
+       delta is recorded so a silently-empty table can't fake a pass.
+    2. **adversarial_crowd** — the storm scenario verdict embedded
+       whole: replayed legit mix + attacking herd, legit SLO with
+       policing on, herd shed >=90% attributed, OFF differential.
+
+    The artifact is the committed BENCH_r19 policing round."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    conns = _env_int("HOSTBENCH_CONNS", 32)
+    secs = float(os.environ.get("HOSTBENCH_SECS", "4"))
+    lanes_n = _env_int("HOSTBENCH_LANES", 4)
+    seed = _env_int("HOSTBENCH_SEED", 7)
+    scale = float(os.environ.get("HOSTBENCH_STORM_SCALE", "1.0"))
+    build_tool()
+    from vproxy_tpu.components.elgroup import EventLoopGroup
+    from vproxy_tpu.components.servergroup import (HealthCheckConfig,
+                                                   ServerGroup)
+    from vproxy_tpu.components.tcplb import TcpLB
+    from vproxy_tpu.components.upstream import Upstream
+    from vproxy_tpu.net import vtl as _v
+    from vproxy_tpu.policing import engine as PE
+    from vproxy_tpu.policing.engine import Policy
+    from vproxy_tpu.utils import sketch as SK
+
+    result = {"policing_conns": conns, "policing_secs": secs,
+              "policing_lanes": lanes_n, "policing_seed": seed,
+              "policing_native": _v.police_supported()}
+    out_path = os.environ.get("HOSTBENCH_RESULT_FILE")
+
+    def flush():
+        if out_path:
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(out_path + ".tmp", out_path)
+
+    procs = []
+    lb = None
+    elg = None
+    groups = []
+    eng = PE.default()
+    try:
+        p, bport = start_server()
+        procs.append(p)
+        elg = EventLoopGroup("w", 4)
+        hc = HealthCheckConfig(timeout_ms=300, period_ms=200, up=1,
+                               down=2)
+        g = ServerGroup("g", elg, hc, "wrr")
+        groups.append(g)
+        g.add("b0", "127.0.0.1", bport, weight=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                not any(s.healthy for s in g.servers):
+            time.sleep(0.05)
+        if not any(s.healthy for s in g.servers):
+            result["policing_error"] = "backend never became healthy"
+            flush()
+            raise RuntimeError(result["policing_error"])
+        ups = Upstream("u")
+        ups.add(g)
+
+        # ---- 1. overhead gate (off vs on, paired + interleaved) -----
+        SK.reset()
+        eng.set_policies([])
+        eng.reset()
+        PE.configure(True)
+        lb = TcpLB("lb-pol", elg, elg, "127.0.0.1", 0, ups,
+                   protocol="tcp", lanes=lanes_n)
+        lb.start()
+        result["policing_lane_engine"] = (lb.lanes.engine()
+                                          if lb.lanes is not None
+                                          else "off")
+        # a quota the bench can never trip: every accept runs the full
+        # probe + debit (the measured cost) and zero accepts shed (a
+        # shed would make ON *faster* and rot the gate's meaning)
+        eng.set_policy(Policy("bench", "clients", 1e5, 2e5, "shed"))
+        run_client(lb.bind_port, min(conns, 8), 1.0, 1, short=True)
+        # the bench client must be IN the installed table before the
+        # measured pairs: wait for the lane drain to surface it, then
+        # tick (detection precedes enforcement, the storm discipline)
+        deadline = time.time() + 6
+        while time.time() < deadline and not any(
+                r["key"] == "127.0.0.1"
+                for r in SK.top_table("clients", 0)):
+            time.sleep(0.05)
+        PE.tick()
+        result["policing_table_armed"] = any(
+            e["key"] == "127.0.0.1" for e in eng.table_snapshot())
+        checked0 = (_v.police_counters(lb.lanes.handle)[0]
+                    if _v.police_supported() and lb.lanes is not None
+                    else 0)
+        rep_secs = max(2.0, secs / 2)
+
+        def _paired_ratios(knob_a, knob_b, reps=7):
+            # ratio = side_a rps / side_b rps per rep (a=off, b=on:
+            # >1 means the knob costs throughput), order alternating
+            ratios, raw = [], []
+            for rep in range(reps):
+                sides = [("a", knob_a), ("b", knob_b)]
+                if rep % 2:
+                    sides.reverse()
+                rr = {}
+                for name, knob in sides:
+                    PE.configure(knob)
+                    time.sleep(0.5)  # settle: drain the accept burst
+                    rr[name] = run_client(lb.bind_port, conns,
+                                          rep_secs, 1,
+                                          short=True)["rps"]
+                raw.append(rr)
+                ratios.append(rr["a"] / max(1.0, rr["b"]))
+            ratios.sort()
+            return ratios[len(ratios) // 2], raw
+
+        off_vs_absent, raw0 = _paired_ratios(False, False, reps=5)
+        off_vs_on, raw1 = _paired_ratios(False, True)
+        PE.configure(True)
+        ctr = (_v.police_counters(lb.lanes.handle)
+               if _v.police_supported() and lb.lanes is not None
+               else (0, 0, 0, 0, 0))
+        result["policing_overhead_off_vs_absent"] = round(
+            off_vs_absent, 3)
+        result["policing_overhead_off_vs_on"] = round(off_vs_on, 3)
+        result["policing_overhead_pairs"] = {"off_vs_absent": raw0,
+                                             "off_vs_on": raw1}
+        result["policing_probe_checked"] = ctr[0] - checked0
+        result["policing_probe_shed"] = ctr[1]
+        # the ISSUE gate: policing ON costs <= 5% of lane short-conn
+        # throughput (the true per-accept cost is one open-addressed
+        # probe + one integer bucket debit)
+        result["policing_overhead_pass"] = bool(off_vs_on <= 1.05)
+        result["policing_offcost_pass"] = bool(
+            0.8 <= off_vs_absent <= 1.25)
+        # evidence the ON sides measured a LIVE table, not a miss: the
+        # probe found-and-debited, and found-path sheds stayed zero
+        result["policing_probe_active"] = bool(
+            not _v.police_supported()
+            or (ctr[0] - checked0 > 0 and ctr[1] == 0))
+        flush()
+        lb.stop()
+        lb = None
+        eng.set_policies([])
+        eng.reset()
+
+        # ---- 2. the adversarial_crowd verdict, embedded whole -------
+        import storm as ST
+        res = ST.scenario_adversarial_crowd(scale=scale, seed=seed)
+        result["policing_storm"] = res
+        result["policing_storm_pass"] = bool(res.get("pass"))
+        flush()
+    finally:
+        PE.configure(True)
+        try:
+            eng.set_policies([])
+            eng.reset()
+        except Exception:
+            pass
+        if lb is not None:
+            try:
+                lb.stop()
+            except Exception:
+                pass
+        for g_ in groups:
+            try:
+                g_.close()
+            except Exception:
+                pass
+        if elg is not None:
+            try:
+                elg.close()
+            except Exception:
+                pass
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    print(json.dumps(result))
+    flush()
+    ok = (result.get("policing_overhead_pass", False)
+          and result.get("policing_offcost_pass", False)
+          and result.get("policing_probe_active", False)
+          and result.get("policing_storm_pass", False))
+    return 0 if ok else 1
+
+
 def main():
     # SIGTERM (bench.py's stage timeout) must run the finally block —
     # otherwise the native server processes are orphaned forever
@@ -1151,6 +1348,8 @@ def main():
         return run_analytics()
     if "--replay" in sys.argv[1:]:
         return run_replay()
+    if "--policing" in sys.argv[1:]:
+        return run_policing()
 
     # --lanes: run ONLY the accept-lane stage (direct ceiling +
     # serialization evidence + lanes on/off + GIL-contention A/B) —

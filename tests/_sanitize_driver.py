@@ -16,7 +16,11 @@ hottest cross-thread paths of native/vtl.cpp at full concurrency:
    every accept traces; ring shrunk so overflow paths run too);
 4. overload/stat plane: a thread flipping lanes_set_limit /
    lanes_set_shed and reading lanes_stat / lanes_stage_stat /
-   lanes_active / counters concurrently with everything above.
+   lanes_active / counters concurrently with everything above;
+5. policing plane: an installer thread churning POLICE_REC tables
+   (vtl_police_install with bucket carry-over + generation races)
+   against the lane threads' per-accept vtl_police_check probe, the
+   knob atomic flipping, and police_counters reads.
 
 Prints DRIVER_OK plus the counters on success; any sanitizer report
 is the test's to find in the log files. Pure stdlib + the vtl ctypes
@@ -103,6 +107,27 @@ def lane_scenario(deadline: float, errors: list):
             vtl.lane_install(h, rec, 1, [0], g)  # -EAGAIN on races: fine
             time.sleep(0.001)
 
+    # policing churn: the lane threads probe vtl_police_check on every
+    # accept while this thread swaps tables (carrying live buckets),
+    # bumps generations out from under installs, and flips the knob
+    police = vtl.police_supported()
+    pol_keys = [socket.inet_pton(socket.AF_INET, f"127.0.0.{i}")
+                for i in range(1, 9)]
+
+    def police_churn():
+        recs = b"".join(
+            vtl.POLICE_REC.pack(vtl.hh_hash(k), 1000_000, 4000, 2, 0,
+                                b"\0\0") for k in pol_keys)
+        flip = False
+        while not stop.is_set():
+            g = vtl.lane_gen(h)
+            vtl.police_install(h, recs, len(pol_keys), g)  # -EAGAIN ok
+            vtl.police_check(h, pol_keys[0], time.monotonic_ns())
+            vtl.police_counters(h)
+            vtl.police_set_enabled(flip)
+            flip = not flip
+            time.sleep(0.001)
+
     def overload():
         flip = False
         while not stop.is_set():
@@ -136,6 +161,9 @@ def lane_scenario(deadline: float, errors: list):
                 threading.Thread(target=overload, daemon=True),
                 threading.Thread(target=client, daemon=True),
                 threading.Thread(target=client, daemon=True)]
+    if police:
+        threads.append(threading.Thread(target=police_churn,
+                                        daemon=True))
     for t in threads:
         t.start()
     while time.monotonic() < deadline:
@@ -147,12 +175,15 @@ def lane_scenario(deadline: float, errors: list):
         if t.is_alive():
             errors.append(f"thread {t.name} wedged")
     stat = vtl.lanes_stat(h)
+    pol_checked = vtl.police_counters(h)[0] if police else 0
     vtl.lanes_free(h)
     vtl.trace_set_sample(0)
+    vtl.police_set_enabled(True)
     bstop.set()
     bth.join(timeout=2)
     bsrv.close()
-    return {"lane_accepted": stat[0], "lane_served": stat[1]}
+    return {"lane_accepted": stat[0], "lane_served": stat[1],
+            "pol_checked": pol_checked}
 
 
 def flow_scenario(deadline: float, errors: list):

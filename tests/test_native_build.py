@@ -57,6 +57,10 @@ REQUIRED_SYMBOLS = (
     # workload capture (r16): lane-plane inter-arrival + per-connection
     # bytes/duration histograms and the capture knob
     "vtl_lanes_capture_stat", "vtl_workload_set_enabled",
+    # policing probe (r19): the POLICE_REC admission table, its knob,
+    # the generation-stamped install, and the parity check surface
+    "vtl_police_rec_size", "vtl_police_set_enabled", "vtl_police_install",
+    "vtl_police_counters", "vtl_police_check",
 )
 
 
@@ -91,7 +95,8 @@ def test_native_so_rebuilds_and_exports_current_abi():
                 "LANE_PUNT": lib.vtl_lane_punt_size,
                 "MAGLEV_REC": lib.vtl_maglev_rec_size,
                 "TRACE_REC": lib.vtl_trace_rec_size,
-                "HH_REC": lib.vtl_hh_rec_size}
+                "HH_REC": lib.vtl_hh_rec_size,
+                "POLICE_REC": lib.vtl_police_rec_size}
     assert set(size_fns) == set(model), \
         "a shared record gained/lost its vtl_*_rec_size guard — " \
         "update size_fns AND vlint's SHARED_RECORDS together"
@@ -114,7 +119,8 @@ def test_native_so_rebuilds_and_exports_current_abi():
     assert len(vtl.flowcache_counters()) == 5 + len(vtl.FLOW_DROP_REASONS)
     assert len(vtl.lane_counters()) == 5
     # span-id / stage-id tables must cover every C TR_* / LANE_STAGE_*
-    assert len(vtl.TRACE_SPANS) == 6
+    assert len(vtl.TRACE_SPANS) == 7
+    assert len(vtl.POLICE_ACTIONS) == 3  # POLICE_ACT_* contract
     assert len(vtl.trace_counters()) == 2
     assert len(vtl.LANE_STAGES) == 3
 

@@ -98,6 +98,11 @@ def resp(tmp_path, matrix_cert):
     yield app, c, tmp_path
     c.close()
     app.close()
+    # policies live in the process-global decision engine, not the app:
+    # the persistence-replay leg re-adds the matrix row there, so clear
+    # it or it leaks into later suites
+    from vproxy_tpu.policing import engine as _pe
+    _pe.default().set_policies([])
 
 
 def run(c: RespClient, line: str):
@@ -114,6 +119,10 @@ MATRIX = [
     # (intentionally NOT persisted, so the replay block below never sees it)
     ("add fault pump.abort probability 0.5 count 3", "probability 0.5",
      None, "remove fault pump.abort"),
+    # admission policy (docs/robustness.md) — decision-plane resource,
+    # no dependencies; k=v param form, persisted like rule resources
+    ("add policy pol0 dim=clients rate=50 burst=100 action=monitor",
+     "dim clients", None, "remove policy pol0"),
     ("add event-loop-group elg0", None, None,
      "remove event-loop-group elg0"),
     ("add event-loop el0 to event-loop-group elg0", None, None,

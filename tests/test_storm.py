@@ -62,6 +62,32 @@ def test_storm_smoke_replay_flash_crowd():
 
 
 @pytest.mark.storm
+def test_storm_smoke_adversarial_crowd():
+    """Scaled-down policing acceptance: the replayed legit mix holds
+    its SLO while the herd is shed and ATTRIBUTED, the shed receipt is
+    seed-deterministic, and the OFF differential is demonstrated or
+    machine-honestly waived (the flash-crowd headroom rule)."""
+    import storm
+    from vproxy_tpu.utils import sketch
+
+    if not sketch.enabled():
+        pytest.skip("analytics sketches disabled")
+    out = storm.scenario_adversarial_crowd(scale=0.25, seed=5)
+    on = out["rows"]["on"]
+    assert on["legit"]["fail"] == 0, on
+    assert on["herd"]["attempts"] > 0
+    # enforcement, not accident: the sheds carry policing attribution
+    assert on["policed_sheds"] >= 0.9 * on["herd"]["shed"], on
+    assert set(out["slo"]) == {"legit_slo_on", "herd_rejected",
+                               "herd_attributed",
+                               "receipt_deterministic", "differential"}
+    assert out["slo"]["herd_rejected"]["value"] >= 0.90, out["slo"]
+    assert out["slo"]["receipt_deterministic"]["pass"], out
+    assert len(out["determinism_receipt"]) == 16
+    assert out["pass"], out["slo"]
+
+
+@pytest.mark.storm
 def test_restarted_lowest_id_leader_catches_up_from_fleet():
     """The rolling-upgrade edge: node 0 (leader) dies and restarts
     EMPTY while the fleet is generations ahead. It must pull the
@@ -204,7 +230,7 @@ def test_fleet_snapshot_discard_of_unconfirmed_generations_is_loud():
 @pytest.mark.storm
 @pytest.mark.slow
 def test_storm_full_suite():
-    """The real thing: all five scenarios at full scale, every SLO gate
+    """The real thing: every scenario at full scale, every SLO gate
     green, and the flash-crowd differential proved (static FAILS the
     p99 gate adaptive passes, at identical load)."""
     import storm

@@ -43,6 +43,7 @@ SHARED_RECORDS = {
     "MAGLEV_REC": "MaglevRec",
     "TRACE_REC": "TraceRec",
     "HH_REC": "HHRec",
+    "POLICE_REC": "PoliceRec",
 }
 
 # scalar C types we allow in shared records: name -> (size, kind)

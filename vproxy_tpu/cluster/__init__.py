@@ -73,6 +73,17 @@ class ClusterNode:
         # windows would haunt the fleet table forever
         from ..utils import sketch
         meta["hh"] = sketch.gossip_summary() if sketch.enabled() else {}
+        # gossip the local-origin policing enforcement table the same
+        # way (policing/engine): a crowd detected by one node is shed by
+        # every node within a heartbeat period. Same ALWAYS-present
+        # rule: {} overwrites, so a policy removal propagates too.
+        from ..policing import engine as policing
+        meta["police"] = (policing.gossip_summary()
+                          if policing.enabled() else {})
+        # ingest is piggybacked on the heartbeat TX tick (no extra
+        # thread): merge every UP peer's last-gossiped table into the
+        # local engine (local entries win; peer entries age out by TTL)
+        policing.ingest_peer_tables(self.membership.peer_policing())
         return meta
 
     def fleet_analytics(self) -> dict:
@@ -80,6 +91,17 @@ class ClusterNode:
         every UP peer's gossiped summary."""
         from ..utils import sketch
         return sketch.fleet_table(self.membership.peer_analytics())
+
+    def fleet_policing(self) -> dict:
+        """Per-node policed-action attribution for GET /analytics:
+        this node's live counts + nothing gossiped yet beyond tables —
+        peers report their own counts on their own /analytics; here we
+        expose which peers are enforcing (table seq) next to ours."""
+        from ..policing import engine as policing
+        mine = policing.default().policed_by_node()
+        peers = {str(nid): {"seq": (summ or {}).get("seq", 0)}
+                 for nid, summ in self.membership.peer_policing().items()}
+        return {"self": mine, "peers": peers}
 
     def _on_generation(self, gen: int) -> None:
         # new rule generation == new step epoch: every host resets its

@@ -74,6 +74,11 @@ class Peer:
     # ({dim: [[key, count], ...]}, utils/sketch.gossip_summary) — the
     # fleet-merge input for GET /analytics on any node
     hh: Optional[dict] = field(default=None, repr=False)
+    # last policing enforcement summary gossiped the same way
+    # ({"seq", "t": [[dim, key, rate_mtok, burst_mtok, act], ...]},
+    # policing/engine.gossip_summary) — a crowd seen by one node sheds
+    # fleet-wide within one heartbeat period
+    police: Optional[dict] = field(default=None, repr=False)
     _up_cnt: int = 0
     _down_cnt: int = 0
     _rx_since_tick: int = field(default=0, repr=False)
@@ -259,6 +264,15 @@ class Membership:
             return {p.node_id: p.hh for p in self.peers.values()
                     if p.up and p.node_id != self.self_id
                     and p.hh is not None}
+
+    def peer_policing(self) -> dict:
+        """{node_id: gossiped enforcement summary} for every UP peer —
+        the merge input for policing/engine.ingest_peer_tables (local
+        entries always win there; dead peers' tables age out by TTL)."""
+        with self._lock:
+            return {p.node_id: p.police for p in self.peers.values()
+                    if p.up and p.node_id != self.self_id
+                    and p.police is not None}
 
     # ------------------------------------------------- maglev steering
 
@@ -454,6 +468,9 @@ class Membership:
             hh = msg.get("hh")
             if isinstance(hh, dict):  # analytics top-K rides heartbeats
                 p.hh = hh
+            pol = msg.get("police")
+            if isinstance(pol, dict):  # enforcement tables ride them too
+                p.police = pol
             p.last_rx = time.monotonic()
             p._rx_since_tick += 1
         if restarted is not None:

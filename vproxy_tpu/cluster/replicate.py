@@ -71,6 +71,7 @@ REPLICATED_TYPES = frozenset({
     "server", "security-group", "security-group-rule", "cert-key",
     "tcp-lb", "socks5-server", "dns-server", "switch", "vpc", "route",
     "ip", "user", "tap", "docker-network-plugin-controller",
+    "policy",
 })
 
 

@@ -44,6 +44,7 @@ import threading
 from typing import Optional
 
 from ..net import vtl
+from ..policing import engine as policing
 from ..rules.ir import Proto
 from ..utils import events, failpoint, sketch, trace, workload
 from ..utils.ip import parse_ip
@@ -137,6 +138,12 @@ class AcceptLanes:
         self._cap_last = [(0, 0.0) for _ in vtl.LANE_CAPTURES]
         self._cap_bkt_last = [[0] * vtl.LANE_STAGE_BUCKETS
                               for _ in vtl.LANE_CAPTURES]
+        # policing plane (r19): the last POLICE_REC table the engine
+        # compiled (re-stamped after every route recompile — a gen bump
+        # stales the C police table too) + the cumulative C counter
+        # snapshot lane 0 folds deltas from
+        self._police_recs: list = []
+        self._pol_last = (0, 0, 0, 0, 0)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -156,6 +163,9 @@ class AcceptLanes:
         # ...and the workload-capture knob (lane inter-arrival +
         # per-connection histograms gate on one C atomic too)
         workload.push_native_knob()
+        # ...and the policing knob (the lane admission probe gates on
+        # one relaxed C atomic — the knob-off contract)
+        policing.push_native_knob()
         self.handle = vtl.lanes_new(
             lb.bind_ip, lb.bind_port, 512, self.n, lb.in_buffer_size,
             self.uring, lb.timeout_ms, lb.connect_timeout_ms)
@@ -170,6 +180,11 @@ class AcceptLanes:
         lb.security_group.add_listener(self._on_mutation)
         failpoint.on_change.append(self._on_failpoints)
         self._on_failpoints()  # pick up faults armed before start
+        # enforcement-table installer: the decision plane pushes every
+        # recompiled POLICE_REC set through here; seed with the current
+        # table so a lane brought up mid-storm enforces immediately
+        policing.default().on_install.append(self._install_police)
+        self._install_police(policing.default().compile_recs())
         self._compile_install()
         self._compiler = threading.Thread(
             target=self._compile_loop, name=f"lane-compile-{lb.alias}",
@@ -207,6 +222,10 @@ class AcceptLanes:
         lb.security_group.remove_listener(self._on_mutation)
         try:
             failpoint.on_change.remove(self._on_failpoints)
+        except ValueError:
+            pass
+        try:
+            policing.default().on_install.remove(self._install_police)
         except ValueError:
             pass
         with self._hook_lock:
@@ -336,6 +355,57 @@ class AcceptLanes:
             vtl.lanes_set_punt_all(
                 self.handle, failpoint.any_armed_excluding("lane."))
 
+    # ------------------------------------------------------------ policing
+
+    def _install_police(self, recs: list) -> bool:
+        """The decision plane's installer hook: remember the table (the
+        route compiler re-stamps it after every gen bump) and push it
+        into C now."""
+        self._police_recs = list(recs)
+        return self._police_install()
+
+    def _police_install(self) -> bool:
+        """Generation-stamped POLICE_REC install, retried while bumps
+        race it — same contract as the route entry, except a losing
+        stamp fails OPEN (consult-miss = admit) instead of punting."""
+        if not vtl.police_supported():
+            return False
+        with self._handle_lock:
+            if not self.handle:
+                return False
+            for _ in range(8):
+                gen = vtl.lane_gen(self.handle)
+                r = vtl.police_install(
+                    self.handle, b"".join(self._police_recs),
+                    len(self._police_recs), gen)
+                if r >= 0:
+                    return True
+        return False
+
+    def _merge_police(self, handle) -> None:
+        """Fold the C police-counter deltas into the decision plane's
+        attribution — and the legacy shed/overload families via the LB
+        (the PR-9 rule: policed refusals must move the counters pre-r19
+        dashboards alert on). shed/monitor deltas only: a C throttle
+        verdict PUNTS, so the python mirror counts it exactly once;
+        stale deltas are a diagnostic, not an action."""
+        if not vtl.police_supported():
+            return
+        try:
+            cur = vtl.police_counters(handle)
+        except OSError:
+            return
+        _c, shed, _t, mon, _s = cur
+        _lc, lshed, _lt, lmon, _ls = self._pol_last
+        if shed > lshed:
+            d = shed - lshed
+            policing.account_native(self.lb.alias, "shed", "clients", d)
+            self.lb._policed_shed(d)
+        if mon > lmon:
+            policing.account_native(self.lb.alias, "monitor", "clients",
+                                    mon - lmon)
+        self._pol_last = cur
+
     # ------------------------------------------------------------ compile
 
     def _compile_loop(self) -> None:
@@ -369,6 +439,11 @@ class AcceptLanes:
                                      len(recs), aux, gen)
             if r >= 0:
                 self.pick_mode = mode if recs else "empty"
+                # the gen bump that forced this recompile staled the
+                # police table too (same stamp): re-install it so
+                # enforcement resumes — until then mismatched stamps
+                # fail OPEN (admit), never closed
+                self._police_install()
                 return
             # -EAGAIN: a bump landed mid-compile; go again vs new state
         _log.warn(f"lanes {lb.alias}: install kept racing mutations; "
@@ -555,6 +630,10 @@ class AcceptLanes:
             if idx == 0:
                 self._merge_stage_hists(handle)
                 self._merge_capture_hists(handle)
+                self._merge_police(handle)
+                # the decision plane's lazy tick rides the lane-0 poll
+                # cadence (the sketch-rotation idiom: no extra thread)
+                policing.maybe_tick()
             if idx == 0:
                 # retry-budget denominator: lane-SERVED accepts never
                 # pass through _on_accept, but their connect-fail punts

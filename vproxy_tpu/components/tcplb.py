@@ -23,6 +23,7 @@ from typing import Optional
 
 from ..net import vtl
 from ..net.connection import Connection, Handler, ServerSock
+from ..policing import engine as policing
 from ..processors import base as processors
 from ..processors.http1 import HeadParser
 from ..rules.ir import Proto
@@ -559,14 +560,25 @@ class TcpLB:
 
     def _shed_total(self, reason: str):
         """vproxy_lb_shed_total{lb,reason} — reason ∈ {static, adaptive,
-        halfopen}: what WAS silent (which guard refused, and whether the
-        slowloris deadline fired) is now countable per cause."""
+        halfopen, policed}: what WAS silent (which guard refused, and
+        whether the slowloris deadline fired) is now countable per
+        cause."""
         c = self._shed_ctrs.get(reason)
         if c is None:
             from ..utils.metrics import GlobalInspection
             c = self._shed_ctrs[reason] = GlobalInspection.get().get_counter(
                 "vproxy_lb_shed_total", lb=self.alias, reason=reason)
         return c
+
+    def _policed_shed(self, n: int = 1) -> None:
+        """Policed refusals (python mirror verdicts + lane-0's C shed
+        fold). The per-action attribution lives in
+        vproxy_lb_policed_total (the engine accounts it); HERE the
+        legacy families move too — the PR-9 rule: a policed shed is
+        still a shed, and the pre-r19 dashboards alerting on
+        vproxy_lb_shed_total / vproxy_lb_overload_total must see it."""
+        self._shed_total("policed").incr(n)
+        self._overload_total().incr(n)
 
     def _observe_accept(self, seconds: float) -> None:
         g = self._overguard
@@ -842,9 +854,41 @@ class TcpLB:
                           lb=self.alias)
             vtl.close(cfd)
             return
+        # admission policing (vproxy_tpu/policing): the python mirror
+        # of the C lane probe — same table, same integer bucket law, so
+        # a punted (or lanes-off) accept reaches the verdict the lane
+        # probe would have. One branch when the knob is off.
+        if policing.ON:
+            policing.maybe_tick()
+            verdict = policing.check("clients", ip, lb=self.alias,
+                                     trace_id=tid)
+            if verdict == "shed" or (
+                    verdict == "throttle"
+                    and self.active_sessions + self.lane_active()
+                    >= self.effective_max_sessions()):
+                # a throttle verdict defers to the ceiling (sheds only
+                # when the LB is already at its limit); shed refuses
+                # outright. Account BEFORE the RST lands — the engine
+                # attributed the verdict, this folds the legacy
+                # families — and sample the rejection as a police span.
+                self._policed_shed(1)
+                if tid == 0:
+                    tid = trace.maybe_sample()
+                if tid:
+                    now = time.monotonic()
+                    _tspan(tid, "police", now, now, action=verdict)
+                vtl.close_rst(cfd)
+                return
         eff = self.effective_max_sessions()
-        if self.active_sessions + self.lane_active() >= eff:
+        if (self.active_sessions + self.lane_active() >= eff
+                and not policing.overload_spare(ip, lb=self.alias)):
             # overload guard: close-on-accept beats queueing unboundedly.
+            # The policing spare above implements the weighted-fair shed
+            # order: an in-quota classed tenant draws on its
+            # deficit-round-robin budget (refilled per policing tick in
+            # proportion to its declared rate, capped at one burst — so
+            # the elasticity past the ceiling is bounded) while
+            # over-quota and unclassed arrivals shed here first.
             # Lane-owned sessions count against the same budget — the C
             # side bounds itself at the shared ceiling and punts (or
             # RST-sheds, adaptive mode) past it, and this check stops

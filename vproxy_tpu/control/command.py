@@ -72,6 +72,7 @@ TYPES = {
     "cluster-node": "cluster-node", "cn": "cluster-node",
     "trace": "trace",
     "analytics": "analytics",
+    "policy": "policy", "pol": "policy",
 }
 
 PARAM_KEYS = {
@@ -105,6 +106,8 @@ PARAM_KEYS = {
     "seed": "seed",
     "plane": "plane",
     "since": "since", "until": "until",
+    "dim": "dim", "rate": "rate", "burst": "burst",
+    "action": "action", "tenant": "tenant",
 }
 
 FLAGS = {"allow-non-backend", "deny-non-backend", "noipv4", "noipv6"}
@@ -180,6 +183,16 @@ class Command:
                 i += 2
             elif t in FLAGS:
                 c.flags.add(t)
+                i += 1
+            elif "=" in t and t.split("=", 1)[0] in PARAM_KEYS:
+                # k=v param form (`add policy gold dim=clients rate=50
+                # burst=100 action=shed`): same keys, same params dict —
+                # the compact spelling the policing grammar and the
+                # persisted command log use
+                k, v = t.split("=", 1)
+                if not v:
+                    raise CmdError(f"param {k} requires a value")
+                c.params[PARAM_KEYS[k]] = v
                 i += 1
             else:
                 raise CmdError(f"unexpected token {t!r}")
@@ -1405,6 +1418,54 @@ def _h_analytics(app: Application, c: Command):
     raise CmdError(f"unsupported action {c.action} for analytics")
 
 
+def _h_policy(app: Application, c: Command):
+    """`add policy <name> dim=<d> rate=<r> burst=<b>
+    action=monitor|throttle|shed [tenant=<cidr|key>]` — the
+    sketch-driven admission policies (policing/engine). Heavy hitters
+    of `dim` get a token bucket at `rate`/s with `burst` headroom and
+    `action` on over-quota; `tenant` scopes the policy and names its
+    weight class for the fair-shed order (docs/robustness.md).
+    Replicated + persisted like every rule resource."""
+    from ..policing import engine as policing
+    eng = policing.default()
+    if c.action == "add":
+        if any(p["name"] == c.alias for p in eng.list_policies()):
+            raise CmdError(f"policy {c.alias} already exists")
+        for k in ("dim", "rate", "burst", "action"):
+            if k not in c.params:
+                raise CmdError(f"policy requires `{k}=<value>`")
+        try:
+            pol = policing.Policy(
+                c.alias, c.params["dim"], float(c.params["rate"]),
+                float(c.params["burst"]), c.params["action"],
+                tenant=c.params.get("tenant"))
+        except ValueError as e:
+            raise CmdError(str(e))
+        eng.set_policy(pol)
+        eng.tick()  # enforce against the current top-K now, not in ~1s
+        return "OK"
+    if c.action == "list":
+        return [p["name"] for p in eng.list_policies()]
+    if c.action == "list-detail":
+        out = [f"{p['name']} -> dim {p['dim']} rate {p['rate']:g} "
+               f"burst {p['burst']:g} action {p['action']}"
+               + (f" tenant {p['tenant']}" if p["tenant"] else "")
+               for p in eng.list_policies()]
+        st = eng.status()
+        out.append(f"policing {'on' if st['enabled'] else 'off'} "
+                   f"seq {st['seq']} keys {st['keys']} "
+                   f"installs {st['tables_installed_total']} "
+                   f"gossip-merges {st['gossip_merges_total']} "
+                   f"policed {st['policed_total']}")
+        return out
+    if c.action in ("remove", "force-remove"):
+        if not eng.remove_policy(c.alias) and c.action == "remove":
+            raise CmdError(f"policy {c.alias!r} not found")
+        eng.tick()  # drop the keys (and native recs) it was policing
+        return "OK"
+    raise CmdError(f"unsupported action {c.action} for policy")
+
+
 def _h_fault(app: Application, c: Command):
     """`add fault <site> [probability p] [count n] [match m] [seed s]`
     arms a named failpoint (utils/failpoint — the chaos-testing
@@ -1645,6 +1706,7 @@ _HANDLERS = {
     "event-log": _h_eventlog,
     "trace": _h_trace,
     "analytics": _h_analytics,
+    "policy": _h_policy,
     "cluster-node": _h_cluster,
     "resolver": _h_resolver,
     "dns-cache": _h_dnscache,

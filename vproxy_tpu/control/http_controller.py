@@ -201,9 +201,33 @@ class HttpController:
             # view when a cluster is booted — same payload as the
             # inspection server's /analytics (one shared assembly)
             from ..utils import sketch as SK
-            r.resp.end(SK.snapshot_with_fleet())
+            out = SK.snapshot_with_fleet()
+            # per-node policed attribution (the enforcement half of
+            # the analytics loop)
+            from ..policing import engine as PE
+            node = self.app.cluster
+            out["policing"] = (
+                node.fleet_policing() if node is not None
+                else {"self": PE.default().policed_by_node(),
+                      "peers": {}})
+            r.resp.end(out)
 
         srv.get("/analytics", analytics_ep)
+
+        def policing_ep(r: RoutingContext) -> None:
+            # Guardian enforcement surface (docs/robustness.md): engine
+            # status + declared policies + the live per-key bucket
+            # table — same payload as the inspection server's /policing
+            from ..policing import engine as PE
+            eng = PE.default()
+            st = eng.status()
+            st["policy_list"] = eng.list_policies()
+            st["table"] = eng.table_snapshot()
+            st["policed_by_node"] = eng.policed_by_node()
+            st["shed_receipt"] = eng.shed_receipt()
+            r.resp.end(st)
+
+        srv.get("/policing", policing_ep)
 
         def workload_ep(r: RoutingContext) -> None:
             # the workload-capture artifact (utils/workload): the

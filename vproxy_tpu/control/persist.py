@@ -141,6 +141,12 @@ def current_config(app: Application) -> str:
     for a, ctl in app.docker_controllers.items():
         lines.append(f"add docker-network-plugin-controller {a} "
                      f"path {ctl.path}")
+    from ..policing import engine as _policing
+    for p in _policing.default().list_policies():
+        tenant_part = f" tenant={p['tenant']}" if p["tenant"] else ""
+        lines.append(f"add policy {p['name']} dim={p['dim']} "
+                     f"rate={p['rate']:g} burst={p['burst']:g} "
+                     f"action={p['action']}{tenant_part}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

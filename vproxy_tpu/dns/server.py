@@ -27,6 +27,7 @@ from ..components.secgroup import SecurityGroup
 from ..components.upstream import Upstream
 from ..net import vtl
 from ..net.eventloop import SelectorEventLoop
+from ..policing import engine as policing
 from ..rules.ir import Hint, Proto
 from ..utils import sketch, workload
 from ..utils.ip import is_ip_literal, parse_ip
@@ -74,6 +75,11 @@ class DNSServer:
         self._ans_cache: dict = {}  # key -> (expires, token, resp bytes)
         self.cache_hits = 0
         self.drops = 0  # responses the kernel refused (EAGAIN) — counted
+        # qname quarantine (vproxy_tpu/policing): a quarantined qname
+        # answers REFUSED from this packed-response cache — the flood
+        # never re-walks the group or re-packs records
+        self._ref_cache: dict = {}  # key -> (expires, packed REFUSED)
+        self.quarantines = 0
 
     def _send(self, data: bytes, ip: str, port: int) -> None:
         """One response datagram; an EAGAIN under storm load is a DROP
@@ -231,6 +237,14 @@ class DNSServer:
         if sketch.ON:
             for q in qs:
                 sketch.update("qnames", q.qname, plane="dns")
+        # qname-flood quarantine: the policing verdict comes BEFORE the
+        # answer cache (a quarantined name must not serve stale answers
+        # from a pre-quarantine fill) and the REFUSED bytes come from
+        # their own packed cache. One branch when the knob is off.
+        if policing.ON:
+            policing.maybe_tick()
+            if self._quarantine_refuse(req, ip, port, qs):
+                return
         if len(qs) == 1 and self._cache_ms > 0:
             hit = self._cache_lookup(req, qs[0])
             if hit is not None:
@@ -241,6 +255,41 @@ class DNSServer:
         # rides the ClassifyService queue (DNSServer.java:136's scan),
         # coalescing with other in-flight queries across datagrams
         self._handle_q(req, ip, port, qs, 0, [])
+
+    def _quarantine_refuse(self, req: P.Packet, ip: str, port: int,
+                           qs: Optional[list] = None) -> bool:
+        """True = a quarantined qname answered REFUSED (rcode 5) from
+        the packed cache (id patched per query) — the group walk, the
+        record packing and the classify submit never run."""
+        if qs is None:
+            qs = list(req.questions)
+        hit = None
+        for q in qs:
+            if policing.quarantined(q.qname, lb=self.alias):
+                hit = q
+                break
+        if hit is None:
+            return False
+        self.quarantines += 1
+        key = (hit.qname, hit.qtype, req.rd)
+        now = time.monotonic()
+        ent = self._ref_cache.get(key)
+        if ent is not None and now < ent[0]:
+            out = bytearray(ent[1])
+            out[0:2] = req.id.to_bytes(2, "big")
+            self._send(bytes(out), ip, port)
+            return True
+        resp = P.Packet(id=req.id, is_resp=True, aa=False, rd=req.rd,
+                        ra=self.recursive is not None, rcode=5,
+                        questions=list(req.questions), answers=[])
+        data = resp.encode()
+        if len(self._ref_cache) > 1024:
+            self._ref_cache.clear()
+        # the verdict is re-checked per query (quarantine lifting takes
+        # effect immediately); the cache only skips the re-pack
+        self._ref_cache[key] = (now + 1.0, data)
+        self._send(data, ip, port)
+        return True
 
     def _handle_q(self, req: P.Packet, ip: str, port: int, qs: list,
                   i: int, answers: list) -> None:

@@ -1339,6 +1339,12 @@ static std::atomic<uint64_t> g_hh_updates(0), g_hh_overflow(0);
 // utils/workload.configure() (same idiom as sketch.push_native_knob).
 static std::atomic<int> g_wl_on(0);
 
+// policing knob (r19): the accept lanes' POLICE_REC probe gates on this
+// one relaxed load — the knob-off cost per C site, exactly like g_hh_on
+// gates the HH shards. Python pushes it from policing/engine.configure()
+// (same idiom as sketch/workload push_native_knob).
+static std::atomic<int> g_police_on(0);
+
 #pragma pack(push, 1)
 struct FlowKey {          // 26 bytes; must match vtl.py FLOW_REC prefix
   uint32_t sender_ip;     // host-order u32 of the v4 sender addr
@@ -2301,6 +2307,7 @@ static_assert(sizeof(TraceRec) == 40, "TraceRec ABI drifted");
 #define TR_SPLICE 3
 #define TR_CLOSE 4
 #define TR_PUNT 5
+#define TR_POLICE 6  // a policed rejection: aux = action code
 
 static std::atomic<uint64_t> g_trace_sample(0);   // 0 = off, N = 1-in-N
 static std::atomic<uint64_t> g_trace_next(2);     // even ids (python: odd)
@@ -2522,10 +2529,85 @@ struct MaglevRec {  // maglev install record; must match net/vtl.py MAGLEV_REC
   uint8_t v6;
   uint8_t weight;  // informational (the table already encodes weight)
 };
+struct PoliceRec {  // policing install record; must match net/vtl.py POLICE_REC
+  uint64_t key_hash;    // fnv64 over the raw client addr bytes; 0 = unused
+  uint32_t rate_mtok;   // refill rate, milli-tokens / second
+  uint32_t burst_mtok;  // bucket capacity, milli-tokens
+  uint8_t action;       // POLICE_ACT_*
+  uint8_t dim;          // 0 = clients (the only lane-enforced dimension)
+  uint8_t pad[2];
+};
 #pragma pack(pop)
 static_assert(sizeof(LaneRec) == 50, "LaneRec ABI drifted");
 static_assert(sizeof(LanePunt) == 116, "LanePunt ABI drifted");
 static_assert(sizeof(MaglevRec) == 50, "MaglevRec ABI drifted");
+static_assert(sizeof(PoliceRec) == 20, "PoliceRec ABI drifted");
+
+// action-code contract with policing/engine.ACTIONS (index == id)
+#define POLICE_ACT_MONITOR 0
+#define POLICE_ACT_THROTTLE 1
+#define POLICE_ACT_SHED 2
+
+// One policed key's live bucket state. The spinlock serializes the
+// debit read-modify-write across lane threads (the same client can
+// land on every SO_REUSEPORT listener at once); contention is
+// per-HOT-KEY, not per-accept, and the critical section is a handful
+// of integer ops — a std::mutex per slot would dominate the table.
+struct PoliceSlot {
+  uint64_t key_hash = 0;  // 0 = empty (open addressing sentinel)
+  uint32_t rate_mtok = 0, burst_mtok = 0;
+  uint8_t action = POLICE_ACT_MONITOR;
+  std::atomic<int> lk{0};
+  int64_t level_mtok = 0;
+  uint64_t t_ns = 0;
+};
+
+struct PoliceTable {  // immutable layout after install; slots mutate
+  uint64_t gen = 0;   // generation stamp: mismatch = forced consult-miss
+  std::vector<PoliceSlot> slots;  // power-of-two, <= 50% loaded
+};
+
+static PoliceSlot* police_find(PoliceTable* pt, uint64_t h) {
+  if (!pt || pt->slots.empty() || !h) return nullptr;
+  uint32_t cap = (uint32_t)pt->slots.size();
+  uint32_t idx = (uint32_t)h & (cap - 1);
+  for (uint32_t p = 0; p < cap; ++p, idx = (idx + 1) & (cap - 1)) {
+    PoliceSlot& s = pt->slots[idx];
+    if (!s.key_hash) return nullptr;  // empty slot ends the probe chain
+    if (s.key_hash == h) return &s;
+  }
+  return nullptr;
+}
+
+// THE bucket law — integer milli-tokens against explicit monotonic ns,
+// arithmetic mirrored statement-for-statement by python
+// policing/engine.TokenBucket.debit (the C==python parity test drives
+// both with the same timestamp sequence and asserts bit-equality).
+// -> 1 in quota (token taken), 0 over quota.
+static inline int police_debit(PoliceSlot& s, uint64_t now_ns) {
+  while (s.lk.exchange(1, std::memory_order_acquire)) {}
+  if (now_ns > s.t_ns) {
+    // 128-bit product: rate * a minutes-long gap overflows u64 and the
+    // python side (arbitrary precision) would not — parity demands care
+    unsigned __int128 add =
+        (unsigned __int128)s.rate_mtok * (now_ns - s.t_ns) /
+        1000000000ull;
+    uint64_t a = add > (unsigned __int128)s.burst_mtok
+                     ? s.burst_mtok
+                     : (uint64_t)add;
+    int64_t lvl = s.level_mtok + (int64_t)a;
+    s.level_mtok = lvl > (int64_t)s.burst_mtok ? (int64_t)s.burst_mtok
+                                               : lvl;
+    s.t_ns = now_ns;
+  }
+  int ok = 0;
+  if (s.level_mtok >= 1000) {
+    s.level_mtok -= 1000;
+    ok = 1;
+  }
+  s.lk.store(0, std::memory_order_release);
+  return ok;
+}
 
 #define LANE_PUNT_CLASSIC 0
 #define LANE_PUNT_CONNECT_FAIL 1
@@ -2586,8 +2668,9 @@ struct Lanes {
   std::atomic<uint64_t> abort_at_us{0};
   std::atomic<int64_t> max_active{1ll << 30};
   std::atomic<uint64_t> wrr{0};  // shared cursor: even spread across lanes
-  std::mutex mu;                 // guards the route swap
+  std::mutex mu;                 // guards the route + police swaps
   std::shared_ptr<LaneRoute> route;
+  std::shared_ptr<PoliceTable> police;  // r19 admission table (may be null)
   int engine = 0;  // 0 epoll, 1 uring
   int port = 0, bufsize = 65536;
   std::atomic<int> timeout_ms{900000};  // hot-settable (update timeout)
@@ -2629,6 +2712,15 @@ struct Lanes {
   std::atomic<uint64_t> cap_last_accept_us{0};
   // trace sampling cursor (1-in-N across this Lanes object's threads)
   std::atomic<uint64_t> trace_seq{0};
+  // policing probe tallies (r19), drained as deltas by lane 0's python
+  // thread (the _fold_lane_sheds contract): checked counts entries
+  // FOUND in the table; shed = RST-closed here; throttled = over-quota
+  // punts (python's mirror re-decides against the overload ceiling, so
+  // the fold deliberately skips this one — python counts it once);
+  // monitored = over-quota admits; stale = consult-misses forced by a
+  // generation mismatch (the fail-open gate).
+  std::atomic<uint64_t> pol_checked{0}, pol_shed{0}, pol_throttled{0},
+      pol_monitored{0}, pol_stale{0};
 };
 
 #define LANE_STAGE_PICK 0
@@ -2857,9 +2949,14 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
       ow->trace_seq.fetch_add(1, std::memory_order_relaxed) % samp == 0)
     tid = g_trace_next.fetch_add(2, std::memory_order_relaxed);
   std::shared_ptr<LaneRoute> rt;
+  std::shared_ptr<PoliceTable> pt;
+  // the policing knob-off cost on this path is exactly this one
+  // relaxed load (the g_hh_on contract)
+  bool police = g_police_on.load(std::memory_order_relaxed) != 0;
   {
     std::lock_guard<std::mutex> g(ow->mu);
     rt = ow->route;
+    if (police) pt = ow->police;
   }
   uint64_t cur = ow->gen.load(std::memory_order_relaxed);
   if ((int64_t)ow->active.load(std::memory_order_relaxed) >=
@@ -2895,13 +2992,63 @@ static void lane_client(Lane* ln, int cfd, const sockaddr_storage* ss) {
     lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr, tid);
     return;
   }
+  // function-scope storage for a late-resolved peer address: `ss` may
+  // be re-pointed at it inside the police/maglev branches and is read
+  // after the branch ends (lane_hh_note, the connect-fail punt) — a
+  // block-local would leave those reads dangling
+  sockaddr_storage peer;
+  if (police && pt) {
+    // the POLICE_REC probe: ONE open-addressed lookup + bucket debit.
+    // A generation mismatch is a forced consult-miss -> ADMIT: a stale
+    // verdict must fail open (the opposite polarity of the route gate,
+    // which fails closed to python) — refusing paying traffic on stale
+    // evidence is the one thing a policer must never do.
+    if (pt->gen != cur) {
+      ow->pol_stale.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      if (!ss) {  // uring multishot accept reports no peer address
+        socklen_t sl = sizeof(peer);
+        if (getpeername(cfd, (sockaddr*)&peer, &sl) == 0) ss = &peer;
+      }
+      uint8_t ipb[16];
+      int iplen = 0, cport = 0;
+      if (ss && maglev_addr_bytes(ss, ipb, &iplen, &cport)) {
+        PoliceSlot* s = police_find(pt.get(), maglev_fnv64(ipb, iplen));
+        if (s) {
+          ow->pol_checked.fetch_add(1, std::memory_order_relaxed);
+          if (!police_debit(*s, t_acc)) {  // over quota
+            if (s->action == POLICE_ACT_SHED) {
+              // refuse HERE: RST (no TIME_WAIT), no punt, no python —
+              // an attacking herd must not buy a GIL crossing each
+              if (tid)
+                lane_trace(ln, tid, TR_POLICE, t_acc,
+                           mono_ns() - t_acc, POLICE_ACT_SHED, 0);
+              struct linger lg = {1, 0};
+              setsockopt(cfd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+              close(cfd);
+              ow->pol_shed.fetch_add(1, std::memory_order_relaxed);
+              return;
+            }
+            if (s->action == POLICE_ACT_THROTTLE) {
+              // throttle defers to the overload ceiling: punt so the
+              // python mirror decides (shed iff at/over the ceiling)
+              ow->pol_throttled.fetch_add(1, std::memory_order_relaxed);
+              ow->punt_classic.fetch_add(1, std::memory_order_relaxed);
+              g_lane_punt_classic.fetch_add(1, std::memory_order_relaxed);
+              lane_trace_punt(ln, tid, t_acc, 0);
+              lane_emit_punt(ln, cfd, LANE_PUNT_CLASSIC, 0, ss, nullptr,
+                             tid);
+              return;
+            }
+            // monitor: count the over-quota arrival, admit it
+            ow->pol_monitored.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+      }
+    }
+  }
   uint64_t t_pick0 = mono_ns();
   int bidx;
-  // function-scope storage for a late-resolved peer address: `ss` may
-  // be re-pointed at it inside the maglev branch and is read after the
-  // branch ends (lane_hh_note, the connect-fail punt) — a block-local
-  // would leave those reads dangling
-  sockaddr_storage peer;
   if (!rt->maglev.empty()) {
     // consistent-hash pick: one FNV over the client addr (+port when
     // per-connection spread is configured) + one table load. The uring
@@ -3477,6 +3624,120 @@ int vtl_lane_maglev_install(void* lp, const void* recs, int n,
     ow->route = rt;
   }
   return (int)rt->maglev.size();
+}
+
+int vtl_police_rec_size(void) { return (int)sizeof(PoliceRec); }
+
+void vtl_police_set_enabled(int on) {
+  g_police_on.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+// Install the compiled policing table, stamped with the generation read
+// BEFORE the engine's compile began (the vtl_lane_install contract):
+// -EAGAIN when a mutation raced it — python re-reads the generation and
+// recompiles. Live bucket state carries over from the previous table
+// for keys that persist across ticks (a reinstall must not hand every
+// hot client a fresh burst). -> entries installed.
+int vtl_police_install(void* lp, const void* recs, int n, uint64_t gen) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || n < 0 || (n > 0 && !recs)) return -EINVAL;
+  if (gen != ow->gen.load(std::memory_order_relaxed)) return -EAGAIN;
+  std::shared_ptr<PoliceTable> old;
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    old = ow->police;
+  }
+  auto pt = std::make_shared<PoliceTable>();
+  pt->gen = gen;
+  uint32_t cap = 8;
+  while (cap < (uint32_t)(n * 2 + 1)) cap <<= 1;
+  pt->slots = std::vector<PoliceSlot>(cap);
+  const PoliceRec* r = (const PoliceRec*)recs;
+  uint64_t now = mono_ns();
+  int installed = 0;
+  for (int i = 0; i < n; ++i) {
+    if (!r[i].key_hash) continue;  // 0 is the empty-slot sentinel
+    uint32_t idx = (uint32_t)r[i].key_hash & (cap - 1);
+    for (uint32_t p = 0; p < cap; ++p, idx = (idx + 1) & (cap - 1)) {
+      PoliceSlot& s = pt->slots[idx];
+      if (s.key_hash && s.key_hash != r[i].key_hash) continue;
+      bool fresh = !s.key_hash;
+      s.key_hash = r[i].key_hash;
+      s.rate_mtok = r[i].rate_mtok;
+      s.burst_mtok = r[i].burst_mtok;
+      s.action = r[i].action;
+      s.level_mtok = (int64_t)r[i].burst_mtok;  // full (the engine law)
+      s.t_ns = now;
+      PoliceSlot* prev = police_find(old.get(), r[i].key_hash);
+      if (prev && prev->rate_mtok == s.rate_mtok &&
+          prev->burst_mtok == s.burst_mtok) {
+        // same policy parameters: the bucket survives the reinstall
+        // (read under the slot lock — lanes still debit the old table)
+        while (prev->lk.exchange(1, std::memory_order_acquire)) {}
+        s.level_mtok = prev->level_mtok;
+        s.t_ns = prev->t_ns;
+        prev->lk.store(0, std::memory_order_release);
+      }
+      if (fresh) ++installed;
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    ow->police = pt;
+  }
+  return installed;
+}
+
+// out: checked, shed, throttled, monitored, stale -> 5 (this Lanes
+// object only; python drains as deltas on lane 0's tick)
+int vtl_police_counters(void* lp, uint64_t* out) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || !out) return -EINVAL;
+  out[0] = ow->pol_checked.load(std::memory_order_relaxed);
+  out[1] = ow->pol_shed.load(std::memory_order_relaxed);
+  out[2] = ow->pol_throttled.load(std::memory_order_relaxed);
+  out[3] = ow->pol_monitored.load(std::memory_order_relaxed);
+  out[4] = ow->pol_stale.load(std::memory_order_relaxed);
+  return 5;
+}
+
+// Deterministic probe at an explicit timestamp — the C==python parity
+// surface (tests drive this and engine.check_at with the same key/ns
+// sequence and assert identical verdicts) and the TSan driver's churn
+// target. Runs the EXACT accept-path logic including the knob and the
+// generation gate, and bumps the same counters: -2 knob off, -1 forced
+// consult-miss (no table / stale stamp / unknown key -> admit),
+// else 0 admit, or 1 + action code when over quota (1 monitor,
+// 2 throttle, 3 shed).
+int vtl_police_check(void* lp, const void* key, int klen,
+                     uint64_t now_ns) {
+  Lanes* ow = (Lanes*)lp;
+  if (!ow || !key || klen <= 0) return -EINVAL;
+  if (!g_police_on.load(std::memory_order_relaxed)) return -2;
+  std::shared_ptr<PoliceTable> pt;
+  {
+    std::lock_guard<std::mutex> g(ow->mu);
+    pt = ow->police;
+  }
+  if (!pt) return -1;
+  if (pt->gen != ow->gen.load(std::memory_order_relaxed)) {
+    ow->pol_stale.fetch_add(1, std::memory_order_relaxed);
+    return -1;
+  }
+  PoliceSlot* s = police_find(pt.get(),
+                              maglev_fnv64((const uint8_t*)key,
+                                           (size_t)klen));
+  if (!s) return -1;
+  ow->pol_checked.fetch_add(1, std::memory_order_relaxed);
+  if (police_debit(*s, now_ns)) return 0;
+  if (s->action == POLICE_ACT_SHED)
+    ow->pol_shed.fetch_add(1, std::memory_order_relaxed);
+  else if (s->action == POLICE_ACT_THROTTLE)
+    ow->pol_throttled.fetch_add(1, std::memory_order_relaxed);
+  else
+    ow->pol_monitored.fetch_add(1, std::memory_order_relaxed);
+  return 1 + (int)s->action;
 }
 
 int vtl_lanes_set_punt_all(void* lp, int on) {

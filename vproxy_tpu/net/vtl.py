@@ -184,6 +184,14 @@ def _load() -> ctypes.CDLL:
         lib.vtl_lanes_capture_stat.argtypes = [p, c, ctypes.POINTER(u64)]
     except AttributeError:
         pass
+    try:  # policing probe + knob (absent from a pre-r19 .so)
+        lib.vtl_police_rec_size.argtypes = []
+        lib.vtl_police_set_enabled.argtypes = [c]
+        lib.vtl_police_install.argtypes = [p, ctypes.c_char_p, c, u64]
+        lib.vtl_police_counters.argtypes = [p, ctypes.POINTER(u64)]
+        lib.vtl_police_check.argtypes = [p, ctypes.c_char_p, c, u64]
+    except AttributeError:
+        pass
     try:  # switch flow cache (absent from a prebuilt pre-r7 .so)
         lib.vtl_flowcache_new.argtypes = [c, c]
         lib.vtl_flowcache_new.restype = p
@@ -967,7 +975,7 @@ TRACE_REC_FIELDS = ("trace_id", "t_start_ns", "dur_ns", "aux", "lane",
                     "span", "flags", "err")
 # span-id contract with the C TR_* defines (index == id)
 TRACE_SPANS = ("accept", "route_pick", "connect", "splice", "close",
-               "punt")
+               "punt", "police")
 # stage-index contract with the C LANE_STAGE_* defines: the
 # vproxy_accept_stage_us stage each C-side histogram folds into
 LANE_STAGES = ("backend_pick", "handover", "total")
@@ -1193,6 +1201,84 @@ def workload_set_enabled(on: bool) -> None:
     fn = getattr(LIB, "vtl_workload_set_enabled", None)
     if fn is not None:
         fn(1 if on else 0)
+
+
+# ------------------------------------------------------------- policing
+#
+# The C admission table (native/vtl.cpp "PoliceRec"): the policing
+# engine (policing/engine.py) compiles its clients-dimension enforcement
+# entries into POLICE_REC records and installs them generation-stamped
+# into each TcpLB's lanes, where the accept path's probe is one
+# open-addressed lookup + token-bucket debit. key_hash is fnv64 over the
+# RAW client address bytes — the same bytes maglev_addr_bytes hands the
+# C probe, so the engine hashes socket.inet_pton output, never the
+# rendered string.
+
+# key_hash u64, rate_mtok u32, burst_mtok u32, action u8, dim u8,
+# pad 2s — must match the C PoliceRec
+POLICE_REC = struct.Struct("<QIIBB2s")
+POLICE_REC_FIELDS = ("key_hash", "rate_mtok", "burst_mtok", "action",
+                     "dim", "pad")
+# action-code contract with the C POLICE_ACT_* defines (index == id);
+# these map onto policing/engine.ACTIONS entries of the same name
+POLICE_ACTIONS = ("monitor", "throttle", "shed")
+
+_police_supported: bool = None  # type: ignore[assignment]
+
+
+def police_supported() -> bool:
+    """Native provider with the policing symbols AND a matching install-
+    record ABI (a stale committed .so fails the size check and the lanes
+    silently run unpoliced — the python mirror still enforces)."""
+    global _police_supported
+    if _police_supported is None:
+        ok = PROVIDER == "native" and hasattr(LIB, "vtl_police_install")
+        if ok:
+            try:
+                ok = int(LIB.vtl_police_rec_size()) == POLICE_REC.size
+            except Exception:
+                ok = False
+        _police_supported = ok
+    return _police_supported
+
+
+def police_set_enabled(on: bool) -> None:
+    """Flip the one C policing atomic (the lane probes gate their work
+    on it). No-op on a .so without the surface."""
+    fn = getattr(LIB, "vtl_police_set_enabled", None)
+    if fn is not None:
+        fn(1 if on else 0)
+
+
+def police_install(handle: int, packed: bytes, n: int, gen: int) -> int:
+    """Install n POLICE_REC entries stamped with `gen` (read before the
+    engine's compile); -> entries installed, or -EAGAIN when a mutation
+    raced the compile (caller re-reads the generation and recompiles).
+    Bucket state carries over for keys whose parameters are unchanged."""
+    return int(LIB.vtl_police_install(handle, packed, n, gen))
+
+
+def police_counters(handle: int) -> tuple:
+    """(checked, shed, throttled, monitored, stale) for ONE lanes
+    object — cumulative; lane 0's drain folds the DELTAS into the
+    policing attribution (throttled excluded: the python mirror counts
+    those once when it re-decides the punt)."""
+    fn = getattr(LIB, "vtl_police_counters", None)
+    if fn is None:
+        return (0,) * 5
+    out = (ctypes.c_uint64 * 5)()
+    check(fn(handle, out))
+    return tuple(int(x) for x in out)
+
+
+def police_check(handle: int, key: bytes, now_ns: int) -> int:
+    """Probe one raw key at an explicit timestamp through the EXACT
+    accept-path logic (knob, generation gate, bucket debit) — the
+    C==python parity surface. -2 knob off, -1 forced consult-miss
+    (admit), 0 admit, else 1 + action code. Raises on a .so without
+    the symbol."""
+    return int(LIB.vtl_police_check(handle, bytes(key), len(key),
+                                    now_ns))
 
 
 def sendmmsg(fd: int, datas: list, ip: str, port: int) -> int:

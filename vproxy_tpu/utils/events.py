@@ -33,7 +33,8 @@ DEFAULT_CAPACITY = 1024
 # client in `top clients` to its accept-plane events without wading
 # through cluster gossip). An event may carry an explicit plane= field
 # to override; unmapped kinds land in "app".
-EVENT_PLANES = ("accept", "lane", "engine", "cluster", "loop", "app")
+EVENT_PLANES = ("accept", "lane", "engine", "cluster", "loop",
+                "policing", "app")
 _KIND_PLANE = {
     "conn": "accept", "conn_denied": "accept", "drain": "accept",
     "drain_shed": "accept", "overload": "accept",
@@ -48,6 +49,8 @@ _KIND_PLANE = {
     "generation_bump": "cluster", "generation_install": "cluster",
     "generation_reject": "cluster", "generation_discard": "cluster",
     "loop_stall": "loop",
+    "policy_install": "policing", "policy_shed": "policing",
+    "quarantine": "policing",
 }
 
 

@@ -84,6 +84,12 @@ SITES = (
     "switch.flowcache.stale",
     "engine.swap.stall",
     "lane.entry.stale",
+    # policing/engine.check consults this FIRST: a hit pins the verdict
+    # to shed (ctx "<dim>:<key>", so match= selects specific keys) —
+    # tests prove enforcement wiring without traffic shaping. Arming it
+    # punts lane accepts to the python mirror (any_armed_excluding),
+    # which is where the forced verdict applies.
+    "policing.decision.force",
 )
 
 # fired (no args) after any arm/disarm/clear/auto-disarm edge — the

@@ -416,6 +416,26 @@ class GlobalInspection:
         self.registry.gauge_f(
             "vproxy_analytics_enabled",
             lambda: 1.0 if _sketch.enabled() else 0.0)
+        # policing plane (vproxy_tpu/policing — sketch-driven admission):
+        # enforcement-table size, install/gossip counters, and policed-
+        # action totals over the CLOSED action × dim grid, eagerly
+        # registered so a scrape shows the zeros before the first
+        # policy. The per-LB axis stays off this family (an open lb
+        # vocabulary here would defeat the closed-grid registration);
+        # per-LB attribution rides vproxy_lb_shed_total{reason="policed"}
+        # and GET /policing.
+        for k in ("keys", "tables_installed_total", "gossip_merges_total"):
+            self.registry.gauge_f(f"vproxy_policy_{k}",
+                                  lambda k=k: self._policing_stat(k))
+        self.registry.gauge_f("vproxy_policing_enabled",
+                              lambda: self._policing_stat("enabled"))
+        for act in ("monitor", "throttle", "shed"):
+            for dim in _sketch.DIMS:
+                self.registry.gauge_f(
+                    "vproxy_lb_policed_total",
+                    lambda act=act, dim=dim: self._policed_total(act,
+                                                                 dim),
+                    action=act, dim=dim)
         # silent-drop accounting (udp_drop_incr below): created eagerly
         # so a scrape shows the zero before the first drop
         self.get_counter("vproxy_udp_drop_total")
@@ -518,6 +538,21 @@ class GlobalInspection:
     def _trace_py_drops() -> float:
         from . import trace
         return float(trace.py_dropped_total())
+
+    @staticmethod
+    def _policing_stat(key: str) -> float:
+        import sys  # scrape must not force the policing import
+        eng = sys.modules.get("vproxy_tpu.policing.engine")
+        if eng is None:
+            return 0.0
+        return float(eng.default().status().get(key, 0))
+
+    @staticmethod
+    def _policed_total(action: str, dim: str) -> float:
+        import sys  # scrape must not force the policing import
+        eng = sys.modules.get("vproxy_tpu.policing.engine")
+        return 0.0 if eng is None else float(
+            eng.default().policed_total(action=action, dim=dim))
 
     @staticmethod
     def _hh_overflow() -> float:
@@ -779,9 +814,33 @@ def launch_inspection_http(loop, ip: str, port: int):
         # the fleet-merged view when a cluster is booted (one shared
         # assembly across all three serving surfaces)
         from . import sketch as SK
-        ctx.resp.end(SK.snapshot_with_fleet())
+        out = SK.snapshot_with_fleet()
+        # per-node policed attribution (the enforcement half of the
+        # analytics loop — what the detected heavy hitters COST them)
+        from ..cluster import ClusterNode
+        from ..policing import engine as PE
+        node = ClusterNode._instance
+        out["policing"] = (node.fleet_policing() if node is not None
+                           else {"self": PE.default().policed_by_node(),
+                                 "peers": {}})
+        ctx.resp.end(out)
 
     srv.get("/analytics", analytics)
+
+    def policing_ep(ctx) -> None:
+        # the Guardian enforcement surface (vproxy_tpu/policing):
+        # engine status + declared policies + the live enforcement
+        # table (per-key buckets with origin/ttl — local vs gossiped)
+        from ..policing import engine as PE
+        eng = PE.default()
+        st = eng.status()
+        st["policy_list"] = eng.list_policies()
+        st["table"] = eng.table_snapshot()
+        st["policed_by_node"] = eng.policed_by_node()
+        st["shed_receipt"] = eng.shed_receipt()
+        ctx.resp.end(st)
+
+    srv.get("/policing", policing_ep)
 
     def workload_ep(ctx) -> None:
         # the capture artifact (utils/workload): the current window's
